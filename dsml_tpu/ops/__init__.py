@@ -1,0 +1,11 @@
+"""TPU compute ops: XLA collectives, attention, and Pallas kernels."""
+
+from dsml_tpu.ops.collectives import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    naive_all_reduce,
+    reduce_scatter,
+    ring_all_reduce,
+)

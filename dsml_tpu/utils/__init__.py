@@ -1,0 +1,4 @@
+"""Utility layer: config/flags, logging, metrics, data, checkpoint, tracing."""
+
+from dsml_tpu.utils.config import Config, field, parse_cli  # noqa: F401
+from dsml_tpu.utils.logging import get_logger  # noqa: F401

@@ -1,0 +1,45 @@
+"""Structured logging for multi-process runs.
+
+The reference logs with Go's stdlib ``log.Printf`` (SURVEY.md §5.5). Here every
+process (coordinator, device host, trainer) gets a namespaced logger whose
+records carry the process role and — when running under ``jax.distributed`` —
+the host index, so interleaved multi-host logs stay attributable.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+
+_CONFIGURED = False
+
+
+class _Formatter(logging.Formatter):
+    def formatTime(self, record, datefmt=None):  # noqa: N802 (logging API)
+        ct = time.localtime(record.created)
+        return time.strftime("%Y/%m/%d %H:%M:%S", ct)
+
+
+def configure(level: int = logging.INFO, stream=None) -> None:
+    """Install the dsml_tpu log format on the root ``dsml`` logger once."""
+    global _CONFIGURED
+    root = logging.getLogger("dsml")
+    if _CONFIGURED:
+        root.setLevel(level)
+        return
+    handler = logging.StreamHandler(stream or sys.stderr)
+    role = os.environ.get("DSML_ROLE", "")
+    role_tag = f" [{role}]" if role else ""
+    handler.setFormatter(_Formatter(f"%(asctime)s{role_tag} %(name)s: %(message)s"))
+    root.addHandler(handler)
+    root.setLevel(level)
+    root.propagate = False
+    _CONFIGURED = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Return a logger under the ``dsml`` namespace, configuring on first use."""
+    configure(level=getattr(logging, os.environ.get("DSML_LOG_LEVEL", "INFO").upper(), logging.INFO))
+    return logging.getLogger(f"dsml.{name}")

@@ -1,0 +1,100 @@
+"""Training metrics + terminal progress, at parity with the reference client.
+
+The reference reports per-epoch average loss and accuracy
+(``DSML/client/client.go:650-652``), a final test accuracy (``:500-501``), and
+draws per-epoch terminal progress bars via schollz/progressbar
+(``client.go:584-590``; SURVEY.md §5.5). ``EpochMetrics``/``ProgressBar``
+reproduce that surface; ``MetricsLogger`` adds the structured record the
+reference lacked (JSON-lines history usable by tests and benchmarks).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class EpochMetrics:
+    """Running mean loss + accuracy over one epoch."""
+
+    loss_sum: float = 0.0
+    correct: int = 0
+    seen: int = 0
+    batches: int = 0
+
+    def update(self, loss: float, correct: int, batch_size: int) -> None:
+        self.loss_sum += float(loss)
+        self.correct += int(correct)
+        self.seen += int(batch_size)
+        self.batches += 1
+
+    @property
+    def avg_loss(self) -> float:
+        return self.loss_sum / max(self.batches, 1)
+
+    @property
+    def accuracy(self) -> float:
+        return self.correct / max(self.seen, 1)
+
+    def summary(self, epoch: int) -> str:
+        # Same shape as the reference's per-epoch log line (client.go:650-652).
+        return (
+            f"Epoch {epoch}: Average Loss = {self.avg_loss:.4f}, "
+            f"Accuracy = {self.accuracy * 100:.2f}%"
+        )
+
+
+class ProgressBar:
+    """Minimal terminal progress bar (stand-in for schollz/progressbar)."""
+
+    def __init__(self, total: int, desc: str = "", width: int = 30, stream=None, enabled: bool | None = None):
+        self.total = max(total, 1)
+        self.desc = desc
+        self.width = width
+        self.n = 0
+        self.stream = stream or sys.stderr
+        self.enabled = self.stream.isatty() if enabled is None else enabled
+        self._t0 = time.monotonic()
+
+    def update(self, k: int = 1) -> None:
+        self.n += k
+        if not self.enabled:
+            return
+        frac = min(self.n / self.total, 1.0)
+        filled = int(frac * self.width)
+        bar = "=" * filled + ">" + " " * (self.width - filled)
+        rate = self.n / max(time.monotonic() - self._t0, 1e-9)
+        self.stream.write(f"\r{self.desc} [{bar}] {self.n}/{self.total} ({rate:.0f}/s)")
+        if frac >= 1.0:
+            self.stream.write("\n")
+        self.stream.flush()
+
+    def close(self) -> None:
+        if self.enabled and self.n < self.total:
+            self.stream.write("\n")
+            self.stream.flush()
+
+
+class MetricsLogger:
+    """Append-only JSON-lines metrics history with wall-clock timestamps."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self.records: list[dict] = []
+
+    def log(self, **kv) -> dict:
+        rec = {"time": time.time(), **kv}
+        self.records.append(rec)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return rec
+
+    def last(self, **match) -> dict | None:
+        for rec in reversed(self.records):
+            if all(rec.get(k) == v for k, v in match.items()):
+                return rec
+        return None

@@ -1,0 +1,46 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax initializes.
+
+Mirrors the reference's "multi-node without a cluster" test pattern
+(in-process servers on ephemeral ports,
+``DSML/gpu_coordinator_service/gpu_coordinator_server_test.go:20-64``) —
+here the multi-device substrate itself is also virtual:
+``--xla_force_host_platform_device_count=8`` gives 8 CPU devices so every
+mesh/collective/sharding test runs without TPU hardware.
+"""
+
+import os
+
+# The container's sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS pinned to the (single-chip) TPU tunnel, so env vars set here
+# are too late — override through jax.config before any backend initializes.
+# Unit tests run on a virtual 8-device CPU mesh; real-TPU runs are bench.py /
+# examples, not pytest.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices8):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(devices8).reshape(8), ("dev",))

@@ -1,0 +1,142 @@
+"""Collectives correctness — the assertions the reference never made.
+
+The reference's only multi-device "test" of its ring was vacuous (0-device
+communicator, SURVEY.md §8.7) and its benchmark asserted timing only
+(``allreduce_comparison_test.go:127-129``). Here every algorithm is checked
+for value-correctness against numpy on an 8-device mesh, across dtypes and
+every ReduceOp.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dsml_tpu.ops import collectives as C
+
+
+def _run_collective(mesh, fn, per_device, out_spec=P("dev")):
+    """Run fn under shard_map with one shard per device along axis 0."""
+    wrapped = jax.shard_map(fn, mesh=mesh, in_specs=P("dev"), out_specs=out_spec, check_vma=False)
+    return np.asarray(jax.jit(wrapped)(per_device))
+
+
+def _stack(n, shape, dtype, seed=0):
+    rng = np.random.default_rng(seed)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return rng.integers(1, 5, size=(n, *shape)).astype(dtype)
+    # keep values near 1 so PROD stays well-conditioned
+    return (rng.random((n, *shape)) * 0.5 + 0.75).astype(dtype)
+
+
+def _np_reduce(xs, op):
+    if op in (C.ReduceOp.SUM, C.ReduceOp.AVG):
+        out = xs.sum(axis=0)
+        if op == C.ReduceOp.AVG:
+            out = out / xs.shape[0]
+        return out.astype(xs.dtype)
+    if op == C.ReduceOp.PROD:
+        return np.prod(xs, axis=0).astype(xs.dtype)
+    if op == C.ReduceOp.MIN:
+        return xs.min(axis=0)
+    return xs.max(axis=0)
+
+
+@pytest.mark.parametrize("op", list(C.ReduceOp))
+@pytest.mark.parametrize("algorithm", ["ring", "naive", "xla"])
+def test_all_reduce_all_ops(mesh8, op, algorithm):
+    xs = _stack(8, (33,), np.float32)  # 33 not divisible by 8 → exercises padding
+    fn = lambda x: C.all_reduce(x[0], "dev", op, algorithm)[None]
+    out = _run_collective(mesh8, fn, xs)
+    expected = _np_reduce(xs, op)
+    for r in range(8):
+        np.testing.assert_allclose(out[r], expected, rtol=2e-5)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int32, np.uint8, jnp.bfloat16])
+def test_ring_dtypes(mesh8, dtype):
+    """Dtype-aware reduction — fixes the byte-wise uint8 add of the reference
+    (gpu_coordinator_server.go:540-543, SURVEY.md §8.2). uint8 sums that would
+    wrap in the reference are exact here (accumulated wide, cast back)."""
+    xs = _stack(8, (16, 5), dtype)
+    fn = lambda x: C.ring_all_reduce(x[0], "dev", C.ReduceOp.SUM)[None]
+    out = _run_collective(mesh8, fn, xs)
+    wide = np.asarray(xs, dtype=np.float64).sum(axis=0)
+    got = np.asarray(out[0], dtype=np.float64)
+    if dtype == jnp.bfloat16:
+        np.testing.assert_allclose(got, wide, rtol=0.05)
+    elif np.issubdtype(np.dtype(dtype), np.integer):
+        np.testing.assert_array_equal(got, wide.astype(np.dtype(dtype)))  # modular wrap on final cast only
+    else:
+        np.testing.assert_allclose(got, wide, rtol=1e-5)
+
+
+def test_ring_matches_psum_exact_shape(mesh8):
+    xs = _stack(8, (1024,), np.float32, seed=3)
+    ring = _run_collective(mesh8, lambda x: C.ring_all_reduce(x[0], "dev")[None], xs)
+    psum = _run_collective(mesh8, lambda x: C.all_reduce(x[0], "dev")[None], xs)
+    np.testing.assert_allclose(ring, psum, rtol=1e-5)
+
+
+def test_reduce_scatter_then_gather_roundtrip(mesh8):
+    xs = _stack(8, (64, 3), np.float32, seed=1)
+    def fn(x):
+        shard = C.reduce_scatter(x[0], "dev")          # [8,3] shard per rank
+        return C.all_gather(shard, "dev")[None]        # [64,3] reassembled
+    out = _run_collective(mesh8, fn, xs)
+    np.testing.assert_allclose(out[0], xs.sum(axis=0), rtol=1e-5)
+
+
+@pytest.mark.parametrize("op", [C.ReduceOp.MIN, C.ReduceOp.MAX])
+def test_reduce_scatter_nonadditive(mesh8, op):
+    xs = _stack(8, (16, 4), np.float32, seed=2)
+    def fn(x):
+        shard = C.reduce_scatter(x[0], "dev", op)
+        return C.all_gather(shard, "dev")[None]
+    out = _run_collective(mesh8, fn, xs)
+    np.testing.assert_allclose(out[0], _np_reduce(xs, op), rtol=1e-6)
+
+
+def test_all_to_all_transpose(mesh8):
+    # rank r holds row r of an 8x8 id-tagged matrix; all_to_all transposes ownership
+    xs = np.arange(64, dtype=np.float32).reshape(8, 1, 8)
+    def fn(x):
+        return C.all_to_all(x, "dev", split_axis=2, concat_axis=1)
+    out = _run_collective(mesh8, fn, xs)
+    np.testing.assert_array_equal(out.reshape(8, 8), np.arange(64).reshape(8, 8).T)
+
+
+def test_ppermute_ring_rotation(mesh8):
+    xs = np.arange(8, dtype=np.float32).reshape(8, 1)
+    out = _run_collective(mesh8, lambda x: C.ppermute_ring(x, "dev", shift=1), xs)
+    np.testing.assert_array_equal(out.reshape(-1), np.roll(np.arange(8), 1))
+
+
+def test_single_device_early_out():
+    """n=1 all-reduce is the identity (reference early-out,
+    gpu_coordinator_server.go:289-295)."""
+    dev = jax.devices()[0]
+    mesh = Mesh(np.asarray([dev]), ("dev",))
+    xs = _stack(1, (7,), np.float32)
+    wrapped = jax.shard_map(
+        lambda x: C.ring_all_reduce(x[0], "dev")[None],
+        mesh=mesh, in_specs=P("dev"), out_specs=P("dev"), check_vma=False,
+    )
+    with jax.set_mesh(mesh):
+        out = np.asarray(wrapped(xs))
+    np.testing.assert_array_equal(out, xs)
+
+
+def test_make_stacked_all_reduce_host_api(mesh8):
+    """The coordinator-facing API: host stack in, reduced stack out — the
+    postcondition the reference's training loop believed it was getting
+    (SURVEY.md §8.4)."""
+    xs = _stack(8, (101770 // 8,), np.float32, seed=5)  # ~reference grad size
+    run = C.make_stacked_all_reduce(mesh8, C.ReduceOp.SUM, algorithm="ring")
+    out = np.asarray(run(xs))
+    expected = xs.sum(axis=0)
+    for r in range(8):
+        np.testing.assert_allclose(out[r], expected, rtol=1e-4)

@@ -1,0 +1,57 @@
+"""Config/flag layer (closes SURVEY.md §5.6 — the reference had no config)."""
+
+import pytest
+
+from dsml_tpu.utils.config import Config, ConfigError, field
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class Inner(Config):
+    dp: int = field(1, help="data-parallel degree")
+    axes: tuple[str, ...] = field(default_factory=tuple)
+
+
+@dataclasses.dataclass
+class Train(Config):
+    lr: float = field(0.01, help="learning rate")
+    epochs: int = 10
+    use_bf16: bool = True
+    max_steps: int | None = None
+    mesh: Inner = field(default_factory=Inner)
+
+
+def test_cli_parse_nested_and_types():
+    cfg = Train.parse_args(
+        ["--lr", "0.1", "--epochs=3", "--use_bf16", "false", "--mesh.dp", "4", "--mesh.axes", "dp,tp"]
+    )
+    assert cfg.lr == 0.1 and cfg.epochs == 3 and cfg.use_bf16 is False
+    assert cfg.mesh.dp == 4 and cfg.mesh.axes == ("dp", "tp")
+
+
+def test_pep604_optional_coercion():
+    cfg = Train.parse_args(["--max_steps", "100"])
+    assert cfg.max_steps == 100 and isinstance(cfg.max_steps, int)
+    assert Train.parse_args(["--max_steps", "none"]).max_steps is None
+
+
+def test_unknown_key_and_bad_path_raise_config_error():
+    with pytest.raises(ConfigError):
+        Train.parse_args(["--nope", "1"])
+    with pytest.raises(ConfigError):
+        Train.parse_args(["--lr.decay", "0.9"])  # intermediate is not a Config
+    with pytest.raises(ConfigError):
+        Train.parse_args(["--epochs", "abc"])
+
+
+def test_file_roundtrip(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(Train(lr=0.5, mesh=Inner(dp=8)).to_json())
+    cfg = Train.parse_args(["--config", str(p), "--epochs", "2"])
+    assert cfg.lr == 0.5 and cfg.mesh.dp == 8 and cfg.epochs == 2
+
+
+def test_usage_text_mentions_nested_flags():
+    text = Train.usage()
+    assert "--mesh.dp" in text and "learning rate" in text
