@@ -1,0 +1,1 @@
+"""L3 — process launchers (device host, coordinator, trainer)."""

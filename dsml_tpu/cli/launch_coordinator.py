@@ -1,0 +1,42 @@
+"""Launch the GPUCoordinator server.
+
+Reference counterpart: ``DSML/cmd/gpu_coordinator_server/main.go`` (hard-coded
+:50051). Health-loop cadence, dial retries, and the collective algorithm are
+flags here.
+
+Usage:
+    python -m dsml_tpu.cli.launch_coordinator --port 50051
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from dsml_tpu.comm.coordinator import CoordinatorConfig
+from dsml_tpu.utils.config import Config, field
+
+
+@dataclasses.dataclass
+class CoordinatorCLIConfig(Config):
+    port: int = field(50051, help="bind port (reference default)")
+    host: str = field("127.0.0.1", help="bind address")
+    coordinator: CoordinatorConfig = field(default_factory=CoordinatorConfig)
+
+
+def main(argv=None) -> None:
+    cfg = CoordinatorCLIConfig.parse_args(argv)
+    from dsml_tpu.comm.coordinator import serve_coordinator
+    from dsml_tpu.utils.logging import get_logger
+
+    handle = serve_coordinator(port=cfg.port, config=cfg.coordinator, host=cfg.host)
+    get_logger("launch").info("coordinator on %s", handle.address)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        handle.stop()
+
+
+if __name__ == "__main__":
+    main()

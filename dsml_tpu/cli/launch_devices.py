@@ -1,0 +1,69 @@
+"""Launch GPUDevice servers, one per local accelerator chip.
+
+Reference counterpart: ``DSML/cmd/gpu_device_server/main.go`` (3 servers on
+hard-coded ports 5003-5005). Here everything is configurable (SURVEY.md §5.6)
+and each server fronts a real ``jax.Device``.
+
+Usage:
+    python -m dsml_tpu.cli.launch_devices --num_devices 3 --base_port 5003
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from dsml_tpu.utils.config import Config, field
+
+
+@dataclasses.dataclass
+class DeviceHostConfig(Config):
+    num_devices: int = field(0, help="number of device servers (0 = one per local chip)")
+    base_port: int = field(5003, help="first port; server i binds base_port+i (0 = ephemeral)")
+    base_device_id: int = field(1, help="deviceId of the first server (reference uses 1..3)")
+    # Large enough by default for the MLP weight/grad buffers (~437 KB each)
+    # the on-device compute path serves; the reference's 12 KB (0x3000) only
+    # fit its streamed test payloads.
+    mem_size: int = field(0x400000, help="per-device address-space size in bytes")
+    host: str = field("127.0.0.1", help="bind address")
+    mlp_sizes: tuple[int, ...] = field(default_factory=lambda: (784, 128, 64, 10),
+                                       help="layer sizes for the on-device MLP (RunForward/RunBackward)")
+    platform: str = field("", help="jax platform override: cpu|tpu ('' = container default)")
+    cpu_devices: int = field(0, help="virtual CPU device count when --platform cpu")
+
+
+def main(argv=None) -> None:
+    cfg = DeviceHostConfig.parse_args(argv)
+    from dsml_tpu.utils.platform import configure_platform
+
+    configure_platform(cfg.platform, cfg.cpu_devices)
+    import jax
+
+    from dsml_tpu.comm.device_server import serve_local_devices
+    from dsml_tpu.models.mlp import MLP
+    from dsml_tpu.utils.logging import get_logger
+
+    log = get_logger("launch")
+    n = cfg.num_devices or len(jax.devices())
+    ports = None if cfg.base_port == 0 else [cfg.base_port + i for i in range(n)]
+    handles = serve_local_devices(
+        n,
+        base_device_id=cfg.base_device_id,
+        mem_size=cfg.mem_size,
+        ports=ports,
+        model=MLP(cfg.mlp_sizes),
+    )
+    for h in handles:
+        log.info(
+            "device %d on %s (jax device: %s)", h.runtime.device_id, h.address, h.runtime.jax_device
+        )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        for h in handles:
+            h.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+"""gRPC control plane: wire-compatible device/coordinator services + client.
+
+Layer map (mirrors SURVEY.md §1, rebuilt TPU-native):
+  L0  dsml_tpu/comm/proto/     wire protocol (gpu_sim.proto + generated pb2)
+  L1  dsml_tpu/comm/device     per-chip device runtime (HBM buffer registry)
+  L2  dsml_tpu/comm/coordinator communicator lifecycle + collectives dispatch
+  L4  dsml_tpu/comm/client      training-client library
+"""
+
+from dsml_tpu.comm import proto  # noqa: F401

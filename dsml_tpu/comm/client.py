@@ -1,0 +1,155 @@
+"""L4 — training-client library for the gpu_sim wire API.
+
+Python counterpart of the reference's Go client helpers
+(``DSML/client/client.go``): connect to coordinator + devices (``:504-514``),
+CommInit (``:532-539``), float32↔bytes codecs (``:60-74``), weight/gradient
+shipping (``:204-252``), and the AllReduceRing call (``:622-628``) — plus the
+on-device compute path (RunForward/RunBackward) the reference only stubbed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import grpc
+import numpy as np
+
+from dsml_tpu.comm import rpc
+from dsml_tpu.comm.proto import gpu_sim_pb2 as pb
+
+GRAD_ADDR = 0x1000  # conventional addresses, as in client.go:29-30
+WEIGHTS_ADDR = 0x2000
+
+
+def f32_to_bytes(x: np.ndarray) -> bytes:
+    return np.ascontiguousarray(x, dtype=np.float32).tobytes()
+
+
+def bytes_to_f32(b: bytes) -> np.ndarray:
+    return np.frombuffer(b, dtype=np.float32).copy()
+
+
+@dataclass
+class PipelineClient:
+    """Handle on one coordinator + its communicator's devices."""
+
+    coordinator: rpc._Stub
+    devices: list[rpc._Stub]
+    comm_id: int
+    device_ids: list[int]
+
+    @classmethod
+    def connect(
+        cls, coordinator_addr: str, device_addrs: list[str], timeout: float = 5.0
+    ) -> "PipelineClient":
+        coord = rpc.coordinator_stub(grpc.insecure_channel(coordinator_addr))
+        resp = coord.CommInit(
+            pb.CommInitRequest(numDevices=len(device_addrs), device_addresses=device_addrs),
+            timeout=timeout,
+        )
+        devices = [rpc.device_stub(grpc.insecure_channel(a)) for a in device_addrs]
+        return cls(coord, devices, resp.commId, [m.deviceId.value for m in resp.devices])
+
+    # ---- per-device data movement ---------------------------------------------
+
+    def write(self, rank: int, addr: int, data: bytes | np.ndarray) -> None:
+        if isinstance(data, np.ndarray):
+            data = f32_to_bytes(data)
+        self.devices[rank].Memcpy(
+            pb.MemcpyRequest(
+                hostToDevice=pb.MemcpyHostToDeviceRequest(
+                    hostSrcData=data,
+                    dstDeviceId=pb.DeviceId(value=self.device_ids[rank]),
+                    dstMemAddr=pb.MemAddr(value=addr),
+                )
+            )
+        )
+
+    def read(self, rank: int, addr: int, num_bytes: int) -> bytes:
+        resp = self.devices[rank].Memcpy(
+            pb.MemcpyRequest(
+                deviceToHost=pb.MemcpyDeviceToHostRequest(
+                    srcDeviceId=pb.DeviceId(value=self.device_ids[rank]),
+                    srcMemAddr=pb.MemAddr(value=addr),
+                    numBytes=num_bytes,
+                )
+            )
+        )
+        return resp.deviceToHost.dstData
+
+    def broadcast_weights(self, weights: np.ndarray, addr: int = WEIGHTS_ADDR) -> None:
+        """Ship one weight vector to every device (client.go:642-644)."""
+        data = f32_to_bytes(weights)
+        for rank in range(len(self.devices)):
+            self.write(rank, addr, data)
+
+    # ---- collectives -----------------------------------------------------------
+
+    def all_reduce_ring(
+        self,
+        num_bytes: int,
+        op: int = pb.SUM,
+        mem_addrs: dict[int, int] | None = None,
+        dtype: str = "",
+        timeout: float = 120.0,
+    ) -> None:
+        req = pb.AllReduceRingRequest(commId=self.comm_id, count=num_bytes, op=op, dtype=dtype)
+        for rank, addr in (mem_addrs or {}).items():
+            req.memAddrs[rank].value = addr
+        self.coordinator.AllReduceRing(req, timeout=timeout)
+
+    def naive_all_reduce(self, data_size: int, latency_ms: int = 0, timeout: float = 120.0):
+        return self.coordinator.NaiveAllReduce(
+            pb.NaiveAllReduceRequest(commId=self.comm_id, dataSize=data_size, latencyMs=latency_ms),
+            timeout=timeout,
+        )
+
+    def all_reduce_gradients(
+        self, per_rank_grads: list[np.ndarray], op: int = pb.SUM, addr: int = GRAD_ADDR
+    ) -> np.ndarray:
+        """The training-loop step the reference faked (SURVEY.md §8.4): write
+        each rank's gradient shard-sum, ring-reduce for real, read back the
+        reduction."""
+        n = len(self.devices)
+        if n != len(per_rank_grads):
+            raise ValueError(f"{len(per_rank_grads)} gradient arrays for {n} devices")
+        nbytes = None
+        for rank, g in enumerate(per_rank_grads):
+            data = f32_to_bytes(g)
+            nbytes = len(data) if nbytes is None else nbytes
+            if len(data) != nbytes:
+                raise ValueError("all ranks must contribute equal-size gradients")
+            self.write(rank, addr, data)
+        self.all_reduce_ring(nbytes, op=op, mem_addrs={r: addr for r in range(n)})
+        return bytes_to_f32(self.read(0, addr, nbytes))
+
+    # ---- on-device compute -----------------------------------------------------
+
+    def run_forward(self, rank: int, input_addr: int, output_addr: int) -> int:
+        resp = self.devices[rank].RunForward(
+            pb.RunForwardRequest(
+                deviceId=pb.DeviceId(value=self.device_ids[rank]),
+                inputAddr=pb.MemAddr(value=input_addr),
+                outputAddr=pb.MemAddr(value=output_addr),
+            )
+        )
+        return resp.outputBytes
+
+    def run_backward(self, rank: int, gradient_addr: int) -> None:
+        self.devices[rank].RunBackward(
+            pb.RunBackwardRequest(
+                deviceId=pb.DeviceId(value=self.device_ids[rank]),
+                gradientAddr=pb.MemAddr(value=gradient_addr),
+            )
+        )
+
+    # ---- lifecycle --------------------------------------------------------------
+
+    def status(self) -> int:
+        return self.coordinator.GetCommStatus(pb.GetCommStatusRequest(commId=self.comm_id)).status
+
+    def destroy(self) -> None:
+        self.coordinator.CommDestroy(pb.CommDestroyRequest(commId=self.comm_id))
+
+    def finalize(self) -> None:
+        self.coordinator.CommFinalize(pb.CommFinalizeRequest(commId=self.comm_id))

@@ -1,0 +1,501 @@
+"""L1 — per-chip device runtime: HBM buffer registry, streams, compute.
+
+TPU-native rebuild of the reference's simulated GPU
+(``DSML/gpu_device_service/gpu_device_server.go``): there, a "device" was a
+``map[uint64][]byte`` plus a stream state machine and zero compute
+(``:26-49``). Here every buffer written through ``Memcpy`` lands in the HBM
+of a real ``jax.Device``, ``RunForward``/``RunBackward`` execute jitted XLA
+programs on that device (the reference shipped these RPCs in its generated
+stubs but never implemented them, SURVEY.md §8.9), and P2P streams actually
+move bytes device-server→device-server (the reference's streams were a
+same-device loopback, SURVEY.md §8.1).
+
+Semantics preserved from the reference:
+- flat address space ``[0x1000, 0x1000+memSize)`` with bounds-checked access
+  (``gpu_device_server.go:45-47,195-230``);
+- stream lifecycle IN_PROGRESS→SUCCESS/FAILED with received-length validation
+  (``:112-181``);
+- ``GetDeviceMetadata`` advertising the address range (``:51-62``).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from dataclasses import dataclass, field
+
+import grpc
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dsml_tpu.comm import rpc
+from dsml_tpu.comm.proto import gpu_sim_pb2 as pb
+from dsml_tpu.models.mlp import MLP
+from dsml_tpu.utils.logging import get_logger
+
+log = get_logger("device")
+
+DEFAULT_MIN_ADDR = 0x1000  # the reference's base address (gpu_device_server.go:45)
+_STREAM_CHUNK = 1 << 18  # 256 KiB per DataChunk
+
+# Process-local registry: deviceId -> DeviceRuntime. Lets a colocated
+# coordinator reach device buffers zero-copy instead of through its own
+# socket (the reference ran all "devices" as goroutines of one process too,
+# cmd/gpu_device_server/main.go:13-23).
+_LOCAL_DEVICES: dict[int, "DeviceRuntime"] = {}
+_LOCAL_LOCK = threading.Lock()
+
+
+def local_device(device_id: int) -> "DeviceRuntime | None":
+    with _LOCAL_LOCK:
+        return _LOCAL_DEVICES.get(device_id)
+
+
+class DeviceError(Exception):
+    def __init__(self, code: grpc.StatusCode, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+@dataclass
+class StreamState:
+    """One P2P stream (reference StreamState, gpu_device_server.go:14-24)."""
+
+    stream_id: int
+    status: int = pb.IN_PROGRESS
+    send_addr: int | None = None
+    num_bytes: int = 0
+    dst_rank: int | None = None
+    src_rank: int | None = None
+    recv_addr: int | None = None
+    chunks: list[bytes] = field(default_factory=list)
+    received: int = 0
+    armed: bool = False  # BeginReceive seen
+
+
+class BufferRegistry:
+    """Address-keyed device buffers. Each entry is a uint8 ``jax.Array``
+    resident on ``device`` (HBM on TPU)."""
+
+    def __init__(self, device: jax.Device, min_addr: int, mem_size: int):
+        self.device = device
+        self.min_addr = min_addr
+        self.max_addr = min_addr + mem_size
+        self._buffers: dict[int, jax.Array] = {}
+        self._lock = threading.Lock()
+
+    def check_bounds(self, addr: int, num_bytes: int = 0) -> None:
+        if addr < self.min_addr or addr + num_bytes > self.max_addr:
+            raise DeviceError(
+                grpc.StatusCode.OUT_OF_RANGE,
+                f"address range [{addr:#x}, {addr + num_bytes:#x}) outside "
+                f"device memory [{self.min_addr:#x}, {self.max_addr:#x})",
+            )
+
+    def write(self, addr: int, data: bytes | np.ndarray) -> None:
+        data = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray, memoryview)) else data
+        self.check_bounds(addr, data.nbytes)
+        with self._lock:
+            existing = self._buffers.get(addr)
+            if existing is not None and existing.nbytes > data.nbytes:
+                # Partial write into a larger resident buffer: splice into the
+                # prefix, keep the tail (a plain replace would truncate it).
+                host = np.asarray(jax.device_get(existing)).view(np.uint8).reshape(-1).copy()
+                host[: data.nbytes] = data
+                data = host
+            self._buffers[addr] = jax.device_put(data, self.device)
+
+    def put_array(self, addr: int, arr: jax.Array) -> None:
+        """Store an already-on-device array (zero-copy path for collectives)."""
+        self.check_bounds(addr, arr.nbytes)
+        with self._lock:
+            self._buffers[addr] = arr
+
+    def read(self, addr: int, num_bytes: int | None = None) -> np.ndarray:
+        with self._lock:
+            arr = self._buffers.get(addr)
+        if arr is None:
+            raise DeviceError(grpc.StatusCode.NOT_FOUND, f"no buffer at address {addr:#x}")
+        host = np.asarray(jax.device_get(arr)).view(np.uint8).reshape(-1)
+        if num_bytes is None:
+            return host
+        if num_bytes > host.nbytes:
+            raise DeviceError(
+                grpc.StatusCode.OUT_OF_RANGE,
+                f"requested {num_bytes} bytes from {host.nbytes}-byte buffer at {addr:#x}",
+            )
+        return host[:num_bytes]
+
+    def get_array(self, addr: int) -> jax.Array:
+        with self._lock:
+            arr = self._buffers.get(addr)
+        if arr is None:
+            raise DeviceError(grpc.StatusCode.NOT_FOUND, f"no buffer at address {addr:#x}")
+        return arr
+
+    def nbytes(self, addr: int) -> int:
+        return self.get_array(addr).nbytes
+
+
+class DeviceRuntime:
+    """The device logic, directly callable (the reference's white-box unit
+    tests call server methods the same way, gpu_device_server_test.go)."""
+
+    def __init__(
+        self,
+        device_id: int,
+        mem_size: int = 0x3000,
+        jax_device: jax.Device | None = None,
+        min_addr: int = DEFAULT_MIN_ADDR,
+        model: MLP | None = None,
+        weights_addr: int = 0x2000,
+    ):
+        self.device_id = device_id
+        self.jax_device = jax_device if jax_device is not None else jax.devices()[0]
+        self.memory = BufferRegistry(self.jax_device, min_addr, mem_size)
+        self.streams: dict[int, StreamState] = {}
+        self._stream_lock = threading.Lock()
+        self._next_stream = 1
+        self.peers: dict[int, str] = {}
+        self.self_rank: int | None = None
+        self._peer_stubs: dict[int, rpc._Stub] = {}
+        self._peer_lock = threading.Lock()
+        # On-device compute: flat-f32 MLP programs (RunForward/RunBackward).
+        self.model = model or MLP()
+        self.weights_addr = weights_addr
+        self._last_input: jax.Array | None = None
+        self.bound_address: str | None = None  # set by serve_device once bound
+        with _LOCAL_LOCK:
+            _LOCAL_DEVICES[device_id] = self
+
+    # ---- metadata -------------------------------------------------------------
+
+    def metadata(self) -> pb.DeviceMetadata:
+        return pb.DeviceMetadata(
+            deviceId=pb.DeviceId(value=self.device_id),
+            minMemAddr=pb.MemAddr(value=self.memory.min_addr),
+            maxMemAddr=pb.MemAddr(value=self.memory.max_addr),
+        )
+
+    # ---- memcpy ---------------------------------------------------------------
+
+    def memcpy_h2d(self, addr: int, data: bytes) -> None:
+        self.memory.write(addr, data)
+
+    def memcpy_d2h(self, addr: int, num_bytes: int) -> bytes:
+        return self.read_bytes(addr, num_bytes)
+
+    def read_bytes(self, addr: int, num_bytes: int | None = None) -> bytes:
+        return self.memory.read(addr, num_bytes).tobytes()
+
+    # ---- streams --------------------------------------------------------------
+
+    def begin_send(self, send_addr: int, num_bytes: int, dst_rank: int) -> int:
+        self.memory.check_bounds(send_addr, num_bytes)
+        with self._stream_lock:
+            # Globally unique id (sender-namespaced): two devices' concurrent
+            # sends to the same receiver must not collide in its stream table.
+            stream_id = (self.device_id << 32) | self._next_stream
+            self._next_stream += 1
+            self.streams[stream_id] = StreamState(
+                stream_id, send_addr=send_addr, num_bytes=num_bytes, dst_rank=dst_rank
+            )
+        # Push the payload to the destination in the background, as the proto
+        # intends ("the actual data transfer should happen in the background
+        # initiated by the devices", gpu_sim.proto) — the reference never
+        # implemented the cross-device leg (SURVEY.md §8.1).
+        threading.Thread(target=self._push_stream, args=(stream_id,), daemon=True).start()
+        return stream_id
+
+    def begin_receive(self, stream_id: int, recv_addr: int, num_bytes: int, src_rank: int) -> None:
+        self.memory.check_bounds(recv_addr, num_bytes)
+        with self._stream_lock:
+            st = self.streams.setdefault(stream_id, StreamState(stream_id))
+            st.recv_addr = recv_addr
+            st.num_bytes = num_bytes
+            st.src_rank = src_rank
+            st.armed = True
+            self._maybe_complete_locked(st)
+
+    def receive_chunks(self, chunk_iter) -> bool:
+        """StreamSend handler: accumulate chunks; complete when the armed
+        length arrives (length validation as gpu_device_server.go:165-179)."""
+        stream_id = None
+        for chunk in chunk_iter:
+            with self._stream_lock:
+                st = self.streams.setdefault(chunk.streamId, StreamState(chunk.streamId))
+                stream_id = chunk.streamId
+                st.chunks.append(chunk.data)
+                st.received += len(chunk.data)
+        if stream_id is None:
+            return False
+        with self._stream_lock:
+            st = self.streams[stream_id]
+            return self._maybe_complete_locked(st, final=True)
+
+    def _maybe_complete_locked(self, st: StreamState, final: bool = False) -> bool:
+        if not st.armed or st.recv_addr is None:
+            return True  # waiting for BeginReceive; chunks stay buffered
+        if st.received == st.num_bytes and st.num_bytes > 0:
+            data = b"".join(st.chunks)
+            st.chunks = []  # payload now lives in the registry; don't retain it
+            try:
+                self.memory.write(st.recv_addr, data)
+            except DeviceError:
+                st.status = pb.FAILED
+                return False
+            st.status = pb.SUCCESS
+            return True
+        if final or st.received > st.num_bytes:
+            st.status = pb.FAILED
+            return False
+        return True
+
+    def stream_status(self, stream_id: int) -> int:
+        with self._stream_lock:
+            st = self.streams.get(stream_id)
+            if st is None:
+                raise DeviceError(grpc.StatusCode.NOT_FOUND, f"unknown stream {stream_id}")
+            return st.status
+
+    # ---- peer table + background push ------------------------------------------
+
+    def configure_peers(self, peers: dict[int, str], self_rank: int) -> None:
+        with self._peer_lock:
+            self.peers = dict(peers)
+            self.self_rank = self_rank
+            self._peer_stubs.clear()
+
+    def _peer_stub(self, rank: int) -> rpc._Stub:
+        with self._peer_lock:
+            stub = self._peer_stubs.get(rank)
+            if stub is None:
+                addr = self.peers.get(rank)
+                if addr is None:
+                    raise DeviceError(grpc.StatusCode.FAILED_PRECONDITION, f"no peer address for rank {rank}")
+                stub = rpc.device_stub(grpc.insecure_channel(addr))
+                self._peer_stubs[rank] = stub
+            return stub
+
+    def _push_stream(self, stream_id: int) -> None:
+        with self._stream_lock:
+            st = self.streams[stream_id]
+            send_addr, num_bytes, dst_rank = st.send_addr, st.num_bytes, st.dst_rank
+        try:
+            payload = self.read_bytes(send_addr, num_bytes)
+            if dst_rank is not None and dst_rank == self.self_rank:
+                # Local delivery (a rank sending to itself): the sender's
+                # StreamState IS the receiver's, so only _maybe_complete may
+                # set its status — if BeginReceive hasn't armed it yet the
+                # chunks stay buffered and status stays IN_PROGRESS.
+                with self._stream_lock:
+                    st = self.streams[stream_id]
+                    st.chunks.append(payload)
+                    st.received += len(payload)
+                    self._maybe_complete_locked(st, final=True)
+            else:
+                stub = self._peer_stub(dst_rank)
+
+                def chunks():
+                    for off in range(0, len(payload), _STREAM_CHUNK):
+                        yield pb.DataChunk(data=payload[off : off + _STREAM_CHUNK], streamId=stream_id)
+
+                ok = stub.StreamSend(chunks()).success
+                with self._stream_lock:
+                    self.streams[stream_id].status = pb.SUCCESS if ok else pb.FAILED
+        except Exception as e:  # noqa: BLE001 — background thread must record failure
+            log.warning("device %d: stream %d push failed: %s", self.device_id, stream_id, e)
+            with self._stream_lock:
+                self.streams[stream_id].status = pb.FAILED
+        self._gc_streams()
+
+    _MAX_STREAMS = 4096
+
+    def _gc_streams(self) -> None:
+        """Evict oldest terminal streams so a long-lived server doesn't grow
+        its stream table without bound."""
+        with self._stream_lock:
+            if len(self.streams) <= self._MAX_STREAMS:
+                return
+            for sid in [s.stream_id for s in self.streams.values() if s.status != pb.IN_PROGRESS]:
+                del self.streams[sid]
+                if len(self.streams) <= self._MAX_STREAMS // 2:
+                    break
+
+    # ---- on-device compute ------------------------------------------------------
+
+    def _flat_params(self) -> jax.Array:
+        raw = self.memory.get_array(self.weights_addr)
+        if raw.nbytes != self.model.n_params * 4:
+            raise DeviceError(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"weights buffer at {self.weights_addr:#x} has {raw.nbytes} bytes; "
+                f"model needs {self.model.n_params * 4}",
+            )
+        return jax.lax.bitcast_convert_type(raw.reshape(-1, 4), jnp.float32).reshape(-1)
+
+    def run_forward(self, input_addr: int, output_addr: int) -> int:
+        """Jitted forward on this chip: f32 batch at ``input_addr`` →
+        logits written to ``output_addr``. Returns output byte count."""
+        raw = self.memory.get_array(input_addr)
+        in_features = self.model.sizes[0]
+        if raw.nbytes % (4 * in_features) != 0:
+            raise DeviceError(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"input buffer ({raw.nbytes} B) is not a multiple of a {in_features}-feature f32 row",
+            )
+        x = jax.lax.bitcast_convert_type(raw.reshape(-1, 4), jnp.float32).reshape(-1, in_features)
+        logits = self.model.forward_flat(self._flat_params(), x)
+        out_u8 = jax.lax.bitcast_convert_type(logits, jnp.uint8).reshape(-1)
+        self.memory.put_array(output_addr, out_u8)
+        self._last_input = x
+        return int(out_u8.nbytes)
+
+    def run_backward(self, gradient_addr: int) -> None:
+        """Jitted backward: reads upstream dL/dlogits (f32 [batch, n_out])
+        at ``gradient_addr``, backprops through the last ``run_forward``
+        batch, and overwrites ``gradient_addr`` with flat param grads."""
+        if self._last_input is None:
+            raise DeviceError(grpc.StatusCode.FAILED_PRECONDITION, "run_forward must precede run_backward")
+        raw = self.memory.get_array(gradient_addr)
+        n_out = self.model.sizes[-1]
+        expected = self._last_input.shape[0] * n_out * 4
+        if raw.nbytes != expected:
+            raise DeviceError(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"gradient buffer has {raw.nbytes} bytes; expected {expected} "
+                f"(batch {self._last_input.shape[0]} × {n_out} f32)",
+            )
+        dlogits = jax.lax.bitcast_convert_type(raw.reshape(-1, 4), jnp.float32).reshape(-1, n_out)
+        grads = self.model.backward_flat(self._flat_params(), self._last_input, dlogits)
+        self.memory.put_array(gradient_addr, jax.lax.bitcast_convert_type(grads, jnp.uint8).reshape(-1))
+
+
+# ---------------------------------------------------------------------------
+# gRPC servicer + process bootstrap
+# ---------------------------------------------------------------------------
+
+
+class DeviceServicer:
+    """Wire adapter: DeviceRuntime ⇄ gpu_sim.GPUDevice."""
+
+    def __init__(self, runtime: DeviceRuntime):
+        self.rt = runtime
+
+    def _abort(self, context, err: DeviceError):
+        context.abort(err.code, str(err))
+
+    def GetDeviceMetadata(self, request, context):  # noqa: N802 (RPC names)
+        return pb.GetDeviceMetadataResponse(metadata=self.rt.metadata())
+
+    def BeginSend(self, request, context):  # noqa: N802
+        try:
+            sid = self.rt.begin_send(request.sendBuffAddr.value, request.numBytes, request.dstRank.value)
+        except DeviceError as e:
+            self._abort(context, e)
+        return pb.BeginSendResponse(initiated=True, streamId=pb.StreamId(value=sid))
+
+    def BeginReceive(self, request, context):  # noqa: N802
+        try:
+            self.rt.begin_receive(
+                request.streamId.value, request.recvBuffAddr.value, request.numBytes, request.srcRank.value
+            )
+        except DeviceError as e:
+            self._abort(context, e)
+        return pb.BeginReceiveResponse(initiated=True)
+
+    def StreamSend(self, request_iterator, context):  # noqa: N802
+        return pb.StreamSendResponse(success=self.rt.receive_chunks(request_iterator))
+
+    def GetStreamStatus(self, request, context):  # noqa: N802
+        try:
+            status = self.rt.stream_status(request.streamId.value)
+        except DeviceError as e:
+            self._abort(context, e)
+        return pb.GetStreamStatusResponse(status=status)
+
+    def Memcpy(self, request, context):  # noqa: N802
+        try:
+            if request.HasField("hostToDevice"):
+                h2d = request.hostToDevice
+                self.rt.memcpy_h2d(h2d.dstMemAddr.value, h2d.hostSrcData)
+                return pb.MemcpyResponse(hostToDevice=pb.MemcpyHostToDeviceResponse(success=True))
+            d2h = request.deviceToHost
+            data = self.rt.memcpy_d2h(d2h.srcMemAddr.value, d2h.numBytes or None)
+            return pb.MemcpyResponse(deviceToHost=pb.MemcpyDeviceToHostResponse(dstData=data))
+        except DeviceError as e:
+            self._abort(context, e)
+
+    def ConfigurePeers(self, request, context):  # noqa: N802
+        self.rt.configure_peers(dict(request.peerAddresses), request.selfRank)
+        return pb.ConfigurePeersResponse(success=True)
+
+    def RunForward(self, request, context):  # noqa: N802
+        try:
+            n = self.rt.run_forward(request.inputAddr.value, request.outputAddr.value)
+        except DeviceError as e:
+            self._abort(context, e)
+        return pb.RunForwardResponse(success=True, outputBytes=n)
+
+    def RunBackward(self, request, context):  # noqa: N802
+        try:
+            self.rt.run_backward(request.gradientAddr.value)
+        except DeviceError as e:
+            self._abort(context, e)
+        return pb.RunBackwardResponse(success=True)
+
+
+@dataclass
+class DeviceServerHandle:
+    runtime: DeviceRuntime
+    server: grpc.Server
+    address: str
+
+    def stop(self, grace: float = 0.2) -> None:
+        self.server.stop(grace)
+
+
+def serve_device(
+    device_id: int,
+    port: int = 0,
+    mem_size: int = 0x3000,
+    jax_device: jax.Device | None = None,
+    host: str = "127.0.0.1",
+    model: MLP | None = None,
+) -> DeviceServerHandle:
+    """Boot one GPUDevice server (ephemeral port when ``port=0``)."""
+    runtime = DeviceRuntime(device_id, mem_size=mem_size, jax_device=jax_device, model=model)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+    rpc.add_device_servicer(DeviceServicer(runtime), server)
+    bound = server.add_insecure_port(f"{host}:{port}")
+    server.start()
+    runtime.bound_address = f"{host}:{bound}"
+    return DeviceServerHandle(runtime, server, runtime.bound_address)
+
+
+def serve_local_devices(
+    n: int,
+    base_device_id: int = 1,
+    mem_size: int = 0x3000,
+    ports: list[int] | None = None,
+    model: MLP | None = None,
+) -> list[DeviceServerHandle]:
+    """Boot n device servers in this process, one per local ``jax.Device``
+    (round-robin if n exceeds the device count) — the shape of the
+    reference's launcher, which ran 3 simulated devices as goroutines
+    (cmd/gpu_device_server/main.go:13-23), except each server here fronts
+    real accelerator memory."""
+    devs = jax.devices()
+    handles = []
+    for i in range(n):
+        handles.append(
+            serve_device(
+                base_device_id + i,
+                port=(ports[i] if ports else 0),
+                mem_size=mem_size,
+                jax_device=devs[i % len(devs)],
+                model=model,
+            )
+        )
+    return handles

@@ -1,0 +1,8 @@
+"""Generated protobuf messages for the gpu_sim wire protocol.
+
+Regenerate with:
+    protoc --python_out=dsml_tpu/comm/proto -I dsml_tpu/comm/proto \
+        dsml_tpu/comm/proto/gpu_sim.proto
+"""
+
+from dsml_tpu.comm.proto import gpu_sim_pb2  # noqa: F401

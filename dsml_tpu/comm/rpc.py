@@ -1,0 +1,97 @@
+"""gRPC service bindings for the gpu_sim protocol, built by hand.
+
+The image ships ``protoc`` (message codegen) but not ``grpc_tools`` (the
+``*_pb2_grpc.py`` plugin), so the service layer is declared here from method
+tables and wired through grpc's generic-handler API. This replaces the
+reference's generated ``gpu_sim_grpc.pb.go`` stubs
+(``/root/reference/DSML/proto/gpu_sim_grpc.pb.go:22-31,147-185``) — same
+RPC paths on the wire (``/gpu_sim.GPUDevice/...``), so peers generated from
+the reference proto interoperate.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from dsml_tpu.comm.proto import gpu_sim_pb2 as pb
+
+# method name -> (arity, request type, response type)
+# arity: "uu" = unary-unary, "su" = stream-unary
+_DEVICE_METHODS = {
+    "GetDeviceMetadata": ("uu", pb.GetDeviceMetadataRequest, pb.GetDeviceMetadataResponse),
+    "BeginSend": ("uu", pb.BeginSendRequest, pb.BeginSendResponse),
+    "BeginReceive": ("uu", pb.BeginReceiveRequest, pb.BeginReceiveResponse),
+    "StreamSend": ("su", pb.DataChunk, pb.StreamSendResponse),
+    "GetStreamStatus": ("uu", pb.GetStreamStatusRequest, pb.GetStreamStatusResponse),
+    "Memcpy": ("uu", pb.MemcpyRequest, pb.MemcpyResponse),
+    "ConfigurePeers": ("uu", pb.ConfigurePeersRequest, pb.ConfigurePeersResponse),
+    "RunForward": ("uu", pb.RunForwardRequest, pb.RunForwardResponse),
+    "RunBackward": ("uu", pb.RunBackwardRequest, pb.RunBackwardResponse),
+}
+
+_COORDINATOR_METHODS = {
+    "CommInit": ("uu", pb.CommInitRequest, pb.CommInitResponse),
+    "GetCommStatus": ("uu", pb.GetCommStatusRequest, pb.GetCommStatusResponse),
+    "CommDestroy": ("uu", pb.CommDestroyRequest, pb.CommDestroyResponse),
+    "CommFinalize": ("uu", pb.CommFinalizeRequest, pb.CommFinalizeResponse),
+    "GroupStart": ("uu", pb.GroupStartRequest, pb.GroupStartResponse),
+    "GroupEnd": ("uu", pb.GroupEndRequest, pb.GroupEndResponse),
+    "AllReduceRing": ("uu", pb.AllReduceRingRequest, pb.AllReduceRingResponse),
+    "NaiveAllReduce": ("uu", pb.NaiveAllReduceRequest, pb.NaiveAllReduceResponse),
+    "Memcpy": ("uu", pb.MemcpyRequest, pb.MemcpyResponse),
+}
+
+_SERVICES = {
+    "gpu_sim.GPUDevice": _DEVICE_METHODS,
+    "gpu_sim.GPUCoordinator": _COORDINATOR_METHODS,
+}
+
+
+def add_servicer_to_server(service_name: str, servicer, server: grpc.Server) -> None:
+    """Register ``servicer`` (an object with one method per RPC) on ``server``."""
+    methods = _SERVICES[service_name]
+    handlers = {}
+    for name, (arity, req_cls, resp_cls) in methods.items():
+        fn = getattr(servicer, name)
+        if arity == "uu":
+            handlers[name] = grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString, response_serializer=resp_cls.SerializeToString
+            )
+        else:
+            handlers[name] = grpc.stream_unary_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString, response_serializer=resp_cls.SerializeToString
+            )
+    server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(service_name, handlers),))
+
+
+class _Stub:
+    """Client stub: one callable per RPC, matching generated-stub ergonomics."""
+
+    def __init__(self, channel: grpc.Channel, service_name: str):
+        for name, (arity, req_cls, resp_cls) in _SERVICES[service_name].items():
+            path = f"/{service_name}/{name}"
+            if arity == "uu":
+                callable_ = channel.unary_unary(
+                    path, request_serializer=req_cls.SerializeToString, response_deserializer=resp_cls.FromString
+                )
+            else:
+                callable_ = channel.stream_unary(
+                    path, request_serializer=req_cls.SerializeToString, response_deserializer=resp_cls.FromString
+                )
+            setattr(self, name, callable_)
+
+
+def device_stub(channel: grpc.Channel) -> _Stub:
+    return _Stub(channel, "gpu_sim.GPUDevice")
+
+
+def coordinator_stub(channel: grpc.Channel) -> _Stub:
+    return _Stub(channel, "gpu_sim.GPUCoordinator")
+
+
+def add_device_servicer(servicer, server: grpc.Server) -> None:
+    add_servicer_to_server("gpu_sim.GPUDevice", servicer, server)
+
+
+def add_coordinator_servicer(servicer, server: grpc.Server) -> None:
+    add_servicer_to_server("gpu_sim.GPUCoordinator", servicer, server)

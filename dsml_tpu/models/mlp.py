@@ -1,0 +1,127 @@
+"""MNIST MLP — the reference's (only) model, as jitted XLA programs.
+
+The reference implements a 784→128(ReLU)→10(softmax) MLP with hand-rolled
+pure-Go loops on the client CPU (``DSML/client/client.go:36-202``: init,
+forward, softmax/ReLU, cross-entropy backward, SGD at ``:254-267``). Its
+README documents — but never shipped — a second 64-unit hidden layer and an
+adaptive LR schedule (SURVEY.md §8.8). Here the architecture is configurable
+(default is the documented 784-128-64-10) and everything — forward, backward,
+SGD — is a jitted XLA program that runs on whatever device the params live on
+(TPU MXU for the matmuls).
+
+Also provides the flat-float32 parameter codec the wire protocol needs: the
+reference client ships gradients/weights as one concatenated f32 buffer
+(``client.go:60-74,619``), and the device runtime's ``RunForward`` /
+``RunBackward`` use the same layout.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["MLP"]
+
+
+class MLP:
+    """Configurable fully-connected classifier with flat-param codecs."""
+
+    def __init__(self, sizes: Sequence[int] = (784, 128, 64, 10), dtype=jnp.float32):
+        if len(sizes) < 2:
+            raise ValueError("MLP needs at least input and output sizes")
+        self.sizes = tuple(int(s) for s in sizes)
+        self.dtype = dtype
+        # Flat layout: [W0, b0, W1, b1, ...] — same concatenation order as the
+        # reference's gradient buffer (client.go:619: dW1,dB1,dW2,dB2).
+        self._shapes: list[tuple[int, ...]] = []
+        for fan_in, fan_out in zip(self.sizes[:-1], self.sizes[1:]):
+            self._shapes.append((fan_in, fan_out))
+            self._shapes.append((fan_out,))
+        self.n_params = int(sum(np.prod(s) for s in self._shapes))
+
+    # ---- params ---------------------------------------------------------------
+
+    def init(self, rng: jax.Array | int = 0) -> dict:
+        """He-initialized params (the reference scales by sqrt(2/fan_in) too,
+        client.go:43-58)."""
+        if isinstance(rng, int):
+            rng = jax.random.PRNGKey(rng)
+        params = {}
+        keys = jax.random.split(rng, len(self.sizes) - 1)
+        for i, (fan_in, fan_out) in enumerate(zip(self.sizes[:-1], self.sizes[1:])):
+            params[f"w{i}"] = jax.random.normal(keys[i], (fan_in, fan_out), self.dtype) * jnp.sqrt(
+                2.0 / fan_in
+            )
+            params[f"b{i}"] = jnp.zeros((fan_out,), self.dtype)
+        return params
+
+    def flatten(self, params: dict) -> jax.Array:
+        leaves = []
+        for i in range(len(self.sizes) - 1):
+            leaves.append(params[f"w{i}"].reshape(-1))
+            leaves.append(params[f"b{i}"].reshape(-1))
+        return jnp.concatenate(leaves)
+
+    def unflatten(self, flat: jax.Array) -> dict:
+        params = {}
+        offset = 0
+        for i, _ in enumerate(range(len(self.sizes) - 1)):
+            w_shape, b_shape = self._shapes[2 * i], self._shapes[2 * i + 1]
+            w_n, b_n = int(np.prod(w_shape)), int(np.prod(b_shape))
+            params[f"w{i}"] = flat[offset : offset + w_n].reshape(w_shape)
+            offset += w_n
+            params[f"b{i}"] = flat[offset : offset + b_n].reshape(b_shape)
+            offset += b_n
+        return params
+
+    # ---- compute --------------------------------------------------------------
+
+    def apply(self, params: dict, x: jax.Array) -> jax.Array:
+        """Forward pass to logits. ReLU hidden layers (client.go:112-141)."""
+        h = x
+        n_layers = len(self.sizes) - 1
+        for i in range(n_layers):
+            h = h @ params[f"w{i}"] + params[f"b{i}"]
+            if i < n_layers - 1:
+                h = jax.nn.relu(h)
+        return h
+
+    def loss(self, params: dict, x: jax.Array, y: jax.Array) -> jax.Array:
+        """Mean softmax cross-entropy (client.go:143-202's objective)."""
+        logits = self.apply(params, x)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def loss_and_grads(self, params: dict, x: jax.Array, y: jax.Array):
+        return jax.value_and_grad(self.loss)(params, x, y)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def accuracy_count(self, params: dict, x: jax.Array, y: jax.Array) -> jax.Array:
+        return jnp.sum(jnp.argmax(self.apply(params, x), axis=1) == y)
+
+    # ---- flat-buffer compute (wire-protocol surface) --------------------------
+    # Inputs/outputs as flat f32 device buffers; used by the device runtime's
+    # RunForward/RunBackward RPCs.
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def forward_flat(self, flat_params: jax.Array, x: jax.Array) -> jax.Array:
+        return self.apply(self.unflatten(flat_params), x)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def backward_flat(self, flat_params: jax.Array, x: jax.Array, dlogits: jax.Array) -> jax.Array:
+        """Param-gradient of <logits, dlogits> — i.e. backprop from an
+        upstream logits-gradient, returned in the flat layout."""
+
+        def scalar_fwd(fp):
+            return jnp.vdot(self.apply(self.unflatten(fp), x), dlogits)
+
+        return jax.grad(scalar_fwd)(flat_params)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def sgd_step(self, params: dict, grads: dict, lr: float) -> dict:
+        return jax.tree.map(lambda p, g: p - lr * g, params, grads)

@@ -198,6 +198,11 @@ def _coerce(raw: Any, ftype, dotted: str):
         raise ConfigError(f"--{dotted}: cannot parse {raw!r} as bool")
     if ftype in (int, float, str):
         try:
+            if ftype is int:
+                try:
+                    return int(raw)  # plain decimal, incl. zero-padded "08"
+                except ValueError:
+                    return int(raw, 0)  # hex/octal/binary (0x3000 memory sizes)
             return ftype(raw)
         except ValueError as e:
             raise ConfigError(f"--{dotted}: {e}") from e

@@ -1,0 +1,19 @@
+"""Platform selection for CLI processes.
+
+The container pins ``JAX_PLATFORMS`` at interpreter start (sitecustomize), so
+env vars alone can't retarget a process; this goes through ``jax.config``
+before any backend initializes.
+"""
+
+from __future__ import annotations
+
+
+def configure_platform(platform: str = "", cpu_devices: int = 0) -> None:
+    """Set the jax platform ("cpu"/"tpu"/"" = container default) and, for
+    CPU, the virtual device count (0 = leave as-is)."""
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    if cpu_devices:
+        jax.config.update("jax_num_cpu_devices", cpu_devices)
