@@ -83,6 +83,10 @@ class BufferRegistry:
         self.min_addr = min_addr
         self.max_addr = min_addr + mem_size
         self._buffers: dict[int, jax.Array] = {}
+        # logical payload size = bytes of the most recent write at an addr
+        # (a short write splices into a larger resident buffer, so the
+        # physical array can be bigger than the current payload)
+        self._last_write: dict[int, int] = {}
         self._lock = threading.Lock()
 
     def check_bounds(self, addr: int, num_bytes: int = 0) -> None:
@@ -96,6 +100,7 @@ class BufferRegistry:
     def write(self, addr: int, data: bytes | np.ndarray) -> None:
         data = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray, memoryview)) else data
         self.check_bounds(addr, data.nbytes)
+        nbytes_in = data.nbytes
         with self._lock:
             existing = self._buffers.get(addr)
             if existing is not None and existing.nbytes > data.nbytes:
@@ -105,12 +110,33 @@ class BufferRegistry:
                 host[: data.nbytes] = data
                 data = host
             self._buffers[addr] = jax.device_put(data, self.device)
+            self._last_write[addr] = nbytes_in
 
     def put_array(self, addr: int, arr: jax.Array) -> None:
         """Store an already-on-device array (zero-copy path for collectives)."""
         self.check_bounds(addr, arr.nbytes)
         with self._lock:
             self._buffers[addr] = arr
+            self._last_write[addr] = arr.nbytes
+
+    def logical_nbytes(self, addr: int) -> int:
+        """Size of the most recent payload written at ``addr`` (≤ physical)."""
+        with self._lock:
+            if addr not in self._buffers:
+                raise DeviceError(grpc.StatusCode.NOT_FOUND, f"no buffer at address {addr:#x}")
+            return self._last_write.get(addr, self._buffers[addr].nbytes)
+
+    def get_logical_array(self, addr: int) -> jax.Array:
+        """The current payload at ``addr``: the resident array sliced to the
+        most recent write's length, read under ONE lock acquisition (a
+        concurrent rewrite between a size query and an array fetch must not
+        mix the two)."""
+        with self._lock:
+            arr = self._buffers.get(addr)
+            if arr is None:
+                raise DeviceError(grpc.StatusCode.NOT_FOUND, f"no buffer at address {addr:#x}")
+            nbytes = self._last_write.get(addr, arr.nbytes)
+        return arr[:nbytes] if nbytes < arr.nbytes else arr
 
     def read(self, addr: int, num_bytes: int | None = None) -> np.ndarray:
         with self._lock:
@@ -326,7 +352,7 @@ class DeviceRuntime:
     # ---- on-device compute ------------------------------------------------------
 
     def _flat_params(self) -> jax.Array:
-        raw = self.memory.get_array(self.weights_addr)
+        raw = self.memory.get_logical_array(self.weights_addr)
         if raw.nbytes != self.model.n_params * 4:
             raise DeviceError(
                 grpc.StatusCode.FAILED_PRECONDITION,
@@ -338,7 +364,7 @@ class DeviceRuntime:
     def run_forward(self, input_addr: int, output_addr: int) -> int:
         """Jitted forward on this chip: f32 batch at ``input_addr`` →
         logits written to ``output_addr``. Returns output byte count."""
-        raw = self.memory.get_array(input_addr)
+        raw = self.memory.get_logical_array(input_addr)
         in_features = self.model.sizes[0]
         if raw.nbytes % (4 * in_features) != 0:
             raise DeviceError(
@@ -358,7 +384,7 @@ class DeviceRuntime:
         batch, and overwrites ``gradient_addr`` with flat param grads."""
         if self._last_input is None:
             raise DeviceError(grpc.StatusCode.FAILED_PRECONDITION, "run_forward must precede run_backward")
-        raw = self.memory.get_array(gradient_addr)
+        raw = self.memory.get_logical_array(gradient_addr)
         n_out = self.model.sizes[-1]
         expected = self._last_input.shape[0] * n_out * 4
         if raw.nbytes != expected:

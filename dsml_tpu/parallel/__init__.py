@@ -1,0 +1,3 @@
+"""Device-mesh parallelism: DP, TP, PP, SP (ring attention), Ulysses, EP."""
+
+from dsml_tpu.parallel.mesh import MeshSpec, build_mesh  # noqa: F401

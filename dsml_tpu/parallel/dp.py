@@ -1,0 +1,95 @@
+"""Data parallelism: sharded-batch train steps with real gradient sync.
+
+The reference's "data parallelism" computed one full-batch forward/backward
+on the client CPU and shipped *identical* gradients to every device, so its
+all-reduce was a functional no-op (SURVEY.md §2.3, §8.4). Here the global
+batch is sharded across the ``dp`` mesh axis and gradients genuinely sync:
+
+- ``algorithm="xla"``  — batch carries ``P('dp')`` sharding into ``jit``; XLA
+  propagates shardings and inserts the topology-optimal all-reduce for the
+  mean-loss gradient. The default for training.
+- ``algorithm="ring"`` — explicit ``shard_map``: per-shard grads are raveled
+  into one flat vector and pushed around the 2(n-1)-step ``ppermute`` ring
+  (``dsml_tpu.ops.collectives.ring_all_reduce``) — the reference's
+  AllReduceRing schedule with honest semantics, usable end-to-end in
+  training (BASELINE.md config: "MNIST MLP, 4 TPU devices, ring AllReduce").
+- ``algorithm="naive"`` — gather-everything baseline, for benchmarks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dsml_tpu.ops.collectives import ReduceOp, all_reduce
+
+__all__ = ["make_dp_train_step", "make_eval_step"]
+
+
+def make_dp_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    algorithm: str = "xla",
+    axis: str = "dp",
+    donate: bool = True,
+):
+    """Build ``step(params, opt_state, x, y) -> (params, opt_state, loss)``.
+
+    ``loss_fn(params, x, y)`` must return the mean loss over its (shard of
+    the) batch. Params/opt-state are replicated; x/y enter sharded along
+    ``axis``. The returned step is jitted over ``mesh``.
+    """
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(axis))
+
+    if algorithm == "xla":
+
+        def compute_grads(params, x, y):
+            return jax.value_and_grad(loss_fn)(params, x, y)
+
+    else:
+
+        def compute_grads(params, x, y):
+            def shard_fn(params, x, y):
+                loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+                flat, unravel = ravel_pytree(grads)
+                flat = all_reduce(flat, axis, ReduceOp.AVG, algorithm)
+                return jax.lax.pmean(loss, axis), unravel(flat)
+
+            return jax.shard_map(
+                shard_fn,
+                mesh=mesh,
+                in_specs=(P(), P(axis), P(axis)),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )(params, x, y)
+
+    def step(params, opt_state, x, y):
+        loss, grads = compute_grads(params, x, y)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(repl, repl, batch_sh, batch_sh),
+        out_shardings=(repl, repl, repl),
+        donate_argnums=(0, 1) if donate else (),
+    )
+
+
+def make_eval_step(model, mesh: Mesh, axis: str = "dp"):
+    """Jitted ``(params, x, y) -> correct_count`` with the batch sharded."""
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(axis))
+
+    def correct(params, x, y):
+        return jnp.sum(jnp.argmax(model.apply(params, x), axis=-1) == y)
+
+    return jax.jit(correct, in_shardings=(repl, batch_sh, batch_sh), out_shardings=repl)

@@ -1,0 +1,80 @@
+"""Device-mesh construction — the substrate every parallelism axis rides on.
+
+The reference's "communicator" is a rank-ordered list of dialed devices
+(``gpu_coordinator_server.go:121-192``); scaling strategies beyond DP exist
+only in its literature corpus (SURVEY.md §2.3). Here the communicator's
+TPU-native generalization is a named ``jax.sharding.Mesh`` with one axis per
+strategy:
+
+    pp   pipeline stages          (outermost: least traffic, coarsest grain)
+    dp   data parallelism / ZeRO  (gradient psum)
+    fsdp param sharding           (all-gather weights, reduce-scatter grads)
+    sp   sequence/context ring    (ring attention ppermute neighbors)
+    tp   tensor parallelism       (innermost: highest-bandwidth collectives)
+
+Axis order is laid out so the highest-traffic axes map to adjacent chips on
+the ICI torus (XLA assigns the innermost mesh axis the fastest locality); EP
+(expert parallel) aliases onto (dp×fsdp) at MoE layers via all_to_all rather
+than occupying a dedicated mesh axis — the LoongTrain/DeepSpeed-style 2D
+split of fast/slow interconnect (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+from jax.sharding import Mesh
+
+from dsml_tpu.utils.config import Config, field
+
+AXES = ("pp", "dp", "fsdp", "sp", "tp")
+
+
+@dataclasses.dataclass
+class MeshSpec(Config):
+    pp: int = field(1, help="pipeline-parallel stages")
+    dp: int = field(0, help="data-parallel size (0 = absorb remaining devices)")
+    fsdp: int = field(1, help="fully-sharded data-parallel (param sharding) size")
+    sp: int = field(1, help="sequence/context-parallel ring size")
+    tp: int = field(1, help="tensor-parallel size")
+
+    def resolved(self, n_devices: int) -> "MeshSpec":
+        """Fill dp=0 with whatever devices remain after the fixed axes."""
+        fixed = self.pp * self.fsdp * self.sp * self.tp
+        dp = self.dp
+        if dp == 0:
+            if n_devices % fixed:
+                raise ValueError(f"{n_devices} devices not divisible by pp*fsdp*sp*tp={fixed}")
+            dp = n_devices // fixed
+        if dp * fixed != n_devices:
+            raise ValueError(
+                f"mesh {self.sizes_dict() | {'dp': dp}} needs {dp * fixed} devices, have {n_devices}"
+            )
+        return dataclasses.replace(self, dp=dp)
+
+    def sizes_dict(self) -> dict:
+        return {a: getattr(self, a) for a in AXES}
+
+    @property
+    def n_devices(self) -> int:
+        return math.prod(self.sizes_dict().values())
+
+
+def build_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:
+    """Build the named mesh over ``devices`` (default: all local devices)."""
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    spec = (spec or MeshSpec()).resolved(len(devices))
+    shape = tuple(getattr(spec, a) for a in AXES)
+    return Mesh(np.asarray(devices).reshape(shape), AXES)
+
+
+def data_mesh(n: int | None = None, devices=None) -> Mesh:
+    """Pure-DP mesh over n (default all) devices."""
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())[: n or None]
+    return build_mesh(MeshSpec(dp=len(devices)), devices)

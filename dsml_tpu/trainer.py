@@ -1,0 +1,142 @@
+"""Data-parallel trainer: the reference's training loop, compiled.
+
+Reproduces the observable behavior of the reference client's epoch loop
+(``DSML/client/client.go:516-659``: batched SGD, per-epoch "Average Loss /
+Accuracy" lines, final test accuracy) with the semantics it intended: the
+global batch is sharded across the mesh's ``dp`` axis, gradients all-reduce
+on-device, and forward/backward/update run as one donated jitted step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy  # noqa: F401 (used via jax.numpy.array in warm-start copy)
+import numpy as np
+import optax
+
+from dsml_tpu.parallel.dp import make_dp_train_step, make_eval_step
+from dsml_tpu.parallel.mesh import data_mesh
+from dsml_tpu.utils.config import Config, field
+from dsml_tpu.utils.data import Dataset, shard_batches
+from dsml_tpu.utils.logging import get_logger
+from dsml_tpu.utils.metrics import EpochMetrics, MetricsLogger
+
+log = get_logger("trainer")
+
+
+@dataclasses.dataclass
+class TrainConfig(Config):
+    epochs: int = field(10, help="training epochs (reference: 10)")
+    batch_size: int = field(64, help="GLOBAL batch size (reference: 64)")
+    lr: float = field(0.01, help="SGD learning rate (reference: 0.01)")
+    optimizer: str = field("sgd", help="sgd | momentum | adam | adamw")
+    lr_schedule: str = field("constant", help="constant | cosine (the adaptive LR the reference README promised but never shipped, SURVEY.md §8.8)")
+    warmup_steps: int = field(0, help="linear warmup steps for the schedule")
+    algorithm: str = field("xla", help="gradient sync: xla | ring | naive")
+    dp: int = field(0, help="data-parallel devices (0 = all local)")
+    seed: int = field(0, help="init + shuffle seed")
+    log_metrics: str = field("", help="optional JSONL metrics path")
+
+
+def _make_optimizer(cfg: TrainConfig, steps_per_epoch: int) -> optax.GradientTransformation:
+    if cfg.lr_schedule == "cosine":
+        total = max(cfg.epochs * steps_per_epoch, 1)
+        lr = optax.warmup_cosine_decay_schedule(
+            0.0, cfg.lr, max(cfg.warmup_steps, 1), total
+        )
+    elif cfg.warmup_steps > 0:
+        lr = optax.join_schedules(
+            [optax.linear_schedule(0.0, cfg.lr, cfg.warmup_steps), optax.constant_schedule(cfg.lr)],
+            [cfg.warmup_steps],
+        )
+    else:
+        lr = cfg.lr
+    return {
+        "sgd": lambda: optax.sgd(lr),
+        "momentum": lambda: optax.sgd(lr, momentum=0.9),
+        "adam": lambda: optax.adam(lr),
+        "adamw": lambda: optax.adamw(lr, weight_decay=1e-4),
+    }[cfg.optimizer]()
+
+
+class Trainer:
+    """Train any model exposing ``init(seed)``, ``loss(params,x,y)``,
+    ``apply(params,x)`` data-parallel over a mesh."""
+
+    def __init__(self, model, config: TrainConfig | None = None, mesh=None):
+        self.model = model
+        self.config = config or TrainConfig()
+        self.mesh = mesh if mesh is not None else data_mesh(self.config.dp or None)
+        self.metrics = MetricsLogger(self.config.log_metrics or None)
+        self._step_fn = None
+        self._eval_fn = None
+
+    def _build(self, steps_per_epoch: int):
+        optimizer = _make_optimizer(self.config, steps_per_epoch)
+        self._step_fn = make_dp_train_step(
+            self.model.loss, optimizer, self.mesh, algorithm=self.config.algorithm
+        )
+        self._eval_fn = make_eval_step(self.model, self.mesh)
+        return optimizer
+
+    def train(self, data: Dataset, params=None):
+        cfg = self.config
+        n_dp = self.mesh.shape.get("dp", 1)
+        if cfg.batch_size % max(n_dp, 1):
+            raise ValueError(f"global batch {cfg.batch_size} not divisible by dp={n_dp}")
+        steps_per_epoch = data.n_train // cfg.batch_size
+        optimizer = self._build(steps_per_epoch)
+        if params is None:
+            params = self.model.init(cfg.seed)
+        else:
+            # The jitted step donates its inputs; copy so the caller's arrays
+            # survive the first step.
+            params = jax.tree.map(lambda a: jax.numpy.array(a), params)
+        opt_state = optimizer.init(params)
+
+        history = []
+        t0 = time.monotonic()
+        for epoch in range(1, cfg.epochs + 1):
+            losses = []  # device arrays; synced only every sync_every steps so
+            # dispatch of step k+1 overlaps execution of step k without the
+            # in-flight queue growing unboundedly
+            sync_every = 32
+            for x, y in shard_batches(data.train_x, data.train_y, cfg.batch_size, seed=cfg.seed + epoch):
+                params, opt_state, loss = self._step_fn(params, opt_state, x, y)
+                losses.append(loss)
+                if len(losses) % sync_every == 0:
+                    losses[-1].block_until_ready()
+            em = EpochMetrics()
+            for loss in losses:
+                em.update(float(loss), 0, cfg.batch_size)
+            train_acc = self.evaluate(params, data.train_x, data.train_y)
+            # Same log shape as the reference's per-epoch line (client.go:650-652).
+            log.info("Epoch %d: Average Loss = %.4f, Accuracy = %.2f%%", epoch, em.avg_loss, train_acc * 100)
+            history.append(
+                self.metrics.log(epoch=epoch, avg_loss=em.avg_loss, train_accuracy=train_acc)
+            )
+        test_acc = self.evaluate(params, data.test_x, data.test_y)
+        wall = time.monotonic() - t0
+        samples = cfg.epochs * steps_per_epoch * cfg.batch_size
+        log.info("Final Test Accuracy: %.2f%%", test_acc * 100)  # client.go:500-501 shape
+        self.metrics.log(
+            test_accuracy=test_acc, wall_time_s=wall, samples_per_sec=samples / max(wall, 1e-9)
+        )
+        return params, history, test_acc
+
+    def evaluate(self, params, x: np.ndarray, y: np.ndarray, batch_size: int = 2048) -> float:
+        n_dp = max(self.mesh.shape.get("dp", 1), 1)
+        n = x.shape[0]
+        usable = n - (n % n_dp)  # each eval batch must split evenly over dp
+        bs = max(batch_size - batch_size % n_dp, n_dp)
+        correct = 0
+        for start in range(0, usable, bs):
+            xb, yb = x[start : start + bs], y[start : start + bs]
+            if xb.shape[0] % n_dp:  # tail: trim to a dp multiple
+                cut = xb.shape[0] - xb.shape[0] % n_dp
+                xb, yb = xb[:cut], yb[:cut]
+            correct += int(self._eval_fn(params, xb, yb))
+        return correct / max(usable, 1)

@@ -1,0 +1,143 @@
+"""Datasets: MNIST IDX parsing, sharded batching, synthetic workloads.
+
+The reference ships gzipped IDX files and parses them in Go
+(``DSML/client/client.go:270-350``). Its mirror is missing the 60k-image
+training blob (``/root/reference/.MISSING_LARGE_BLOBS``, SURVEY.md §8.11), so
+:func:`load_mnist` transparently falls back to carving a train/test split out
+of the 10k test set (and can augment it with pixel shifts to recover headroom)
+— real train images are used automatically when present at
+``data/mnist/train-images-idx3-ubyte.gz``.
+
+Also provides :func:`synthetic_classification` (benchmark workloads never
+bottlenecked on disk) and :func:`shard_batches`, the host-side data-parallel
+batch iterator (per-device shards laid out for a ``dp`` mesh axis).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+from dsml_tpu.utils.logging import get_logger
+
+log = get_logger("data")
+
+_IMAGES_MAGIC = 2051
+_LABELS_MAGIC = 2049
+
+
+def _read_idx(path: str) -> np.ndarray:
+    """Parse one (gzipped) IDX file (images or labels)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, count = struct.unpack(">II", f.read(8))
+        if magic == _IMAGES_MAGIC:
+            rows, cols = struct.unpack(">II", f.read(8))
+            data = np.frombuffer(f.read(count * rows * cols), dtype=np.uint8)
+            return data.reshape(count, rows, cols)
+        if magic == _LABELS_MAGIC:
+            return np.frombuffer(f.read(count), dtype=np.uint8)
+        raise ValueError(f"{path}: unknown IDX magic {magic}")
+
+
+@dataclass
+class Dataset:
+    train_x: np.ndarray  # [N, ...] float32 in [0, 1]
+    train_y: np.ndarray  # [N] int32
+    test_x: np.ndarray
+    test_y: np.ndarray
+
+    @property
+    def n_train(self) -> int:
+        return self.train_x.shape[0]
+
+
+def load_mnist(
+    data_dir: str = "data/mnist",
+    flatten: bool = True,
+    augment_fallback: bool = True,
+    holdout: int = 2000,
+) -> Dataset:
+    """Load MNIST; fall back to a t10k-derived split when the 60k train
+    images are absent (see module docstring)."""
+    train_images = os.path.join(data_dir, "train-images-idx3-ubyte.gz")
+    test_x = _read_idx(os.path.join(data_dir, "t10k-images-idx3-ubyte.gz"))
+    test_y = _read_idx(os.path.join(data_dir, "t10k-labels-idx1-ubyte.gz"))
+    if os.path.exists(train_images):
+        train_x = _read_idx(train_images)
+        train_y = _read_idx(os.path.join(data_dir, "train-labels-idx1-ubyte.gz"))
+    else:
+        log.warning(
+            "train-images blob absent (stripped from the reference mirror); "
+            "splitting t10k %d/%d train/test%s",
+            test_x.shape[0] - holdout, holdout, " with shift augmentation" if augment_fallback else "",
+        )
+        train_x, train_y = test_x[:-holdout], test_y[:-holdout]
+        test_x, test_y = test_x[-holdout:], test_y[-holdout:]
+        if augment_fallback:
+            train_x, train_y = _augment_shifts(train_x, train_y)
+
+    def prep(x):
+        x = x.astype(np.float32) / 255.0
+        return x.reshape(x.shape[0], -1) if flatten else x[..., None]
+
+    return Dataset(prep(train_x), train_y.astype(np.int32), prep(test_x), test_y.astype(np.int32))
+
+
+def _augment_shifts(x: np.ndarray, y: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """5× the data with ±1-pixel translations (cheap, label-preserving)."""
+    shifted = [x]
+    for dy, dx in ((1, 0), (-1, 0), (0, 1), (0, -1)):
+        s = np.roll(x, (dy, dx), axis=(1, 2))
+        # zero the wrapped edge
+        if dy == 1:
+            s[:, 0, :] = 0
+        elif dy == -1:
+            s[:, -1, :] = 0
+        if dx == 1:
+            s[:, :, 0] = 0
+        elif dx == -1:
+            s[:, :, -1] = 0
+        shifted.append(s)
+    return np.concatenate(shifted), np.tile(y, len(shifted))
+
+
+def synthetic_classification(
+    n: int, features: int, classes: int = 10, seed: int = 0, image_shape: tuple | None = None
+) -> Dataset:
+    """Linearly-separable-ish synthetic data; loss must drop fast on it, which
+    makes it the convergence canary for trainer tests and benchmarks."""
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((classes, features)).astype(np.float32) * 2.0
+    y = rng.integers(0, classes, size=n).astype(np.int32)
+    x = centers[y] + rng.standard_normal((n, features)).astype(np.float32)
+    if image_shape is not None:
+        x = x.reshape(n, *image_shape)
+    split = max(1, int(n * 0.9))
+    return Dataset(x[:split], y[:split], x[split:], y[split:])
+
+
+def shard_batches(
+    x: np.ndarray,
+    y: np.ndarray,
+    batch_size: int,
+    seed: int | None = None,
+    drop_remainder: bool = True,
+):
+    """Yield (x_batch, y_batch) host batches, shuffled per epoch. The batch is
+    the GLOBAL batch; the mesh sharding (``P('dp')`` on axis 0) splits it
+    across data-parallel ranks at dispatch — the real data sharding the
+    reference lacked (its 'DP' shipped identical full batches everywhere,
+    SURVEY.md §2.3)."""
+    n = x.shape[0]
+    idx = np.arange(n)
+    if seed is not None:
+        np.random.default_rng(seed).shuffle(idx)
+    end = (n // batch_size) * batch_size if drop_remainder else n
+    for start in range(0, end, batch_size):
+        sel = idx[start : start + batch_size]
+        yield x[sel], y[sel]

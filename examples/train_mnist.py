@@ -1,0 +1,51 @@
+"""Train the MNIST MLP data-parallel over the local device mesh.
+
+The in-process counterpart of the reference's end-to-end run
+(``DSML/client/client.go:516-659`` — 10 epochs, batch 64, SGD lr 0.01,
+92.89% final accuracy on its full 60k train set): same hyperparameter
+defaults, same per-epoch log lines, but the batch is genuinely sharded
+across devices and the gradient sync is a real collective.
+
+    python examples/train_mnist.py --epochs 10
+    python examples/train_mnist.py --platform cpu --cpu_devices 8 --algorithm ring
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+
+sys.path.insert(0, ".")  # repo-root invocation
+
+from dsml_tpu.trainer import TrainConfig
+from dsml_tpu.utils.config import field
+
+
+@dataclasses.dataclass
+class MNISTConfig(TrainConfig):
+    platform: str = field("", help="jax platform override: cpu|tpu ('' = default)")
+    cpu_devices: int = field(0, help="virtual CPU device count for --platform cpu")
+    data_dir: str = field("data/mnist", help="IDX data directory")
+    hidden: tuple[int, ...] = field(default_factory=lambda: (128, 64),
+                                    help="hidden layer sizes (reference README documents 128,64)")
+
+
+def main(argv=None):
+    cfg = MNISTConfig.parse_args(argv)
+    from dsml_tpu.utils.platform import configure_platform
+
+    configure_platform(cfg.platform, cfg.cpu_devices)
+
+    from dsml_tpu.models.mlp import MLP
+    from dsml_tpu.trainer import Trainer
+    from dsml_tpu.utils.data import load_mnist
+
+    data = load_mnist(cfg.data_dir)
+    model = MLP(sizes=(784, *cfg.hidden, 10))
+    trainer = Trainer(model, cfg)
+    _, _, test_acc = trainer.train(data)
+    return test_acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,133 @@
+"""Train MNIST through the FULL wire API — the reference's architecture,
+with real semantics at every hop.
+
+Per batch (compare SURVEY.md §3.2, where gradient sync was a functional
+no-op, §8.4):
+  1. shard the global batch across ranks, Memcpy each shard H2D;
+  2. RunForward on every device (jitted XLA on that chip);
+  3. read logits back, compute dL/dlogits on the host (the API's contract);
+  4. Memcpy dlogits, RunBackward → per-rank param grads in device memory;
+  5. coordinator AllReduceRing(AVG) reduces the PER-RANK (different!) grads;
+  6. read reduced grads once, SGD update on host, broadcast new weights.
+
+Boots its own in-process cluster by default; point --coordinator/--devices
+at live servers to drive an external one.
+
+    python examples/train_mnist_wire.py --platform cpu --cpu_devices 4 --epochs 2
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from dsml_tpu.utils.config import Config, field
+
+
+@dataclasses.dataclass
+class WireConfig(Config):
+    epochs: int = field(3, help="training epochs")
+    batch_size: int = field(64, help="global batch size")
+    lr: float = field(0.1, help="SGD learning rate")
+    n_devices: int = field(0, help="devices for the self-booted cluster (0 = all local)")
+    coordinator: str = field("", help="external coordinator address ('' = boot in-process)")
+    devices: tuple[str, ...] = field(default_factory=tuple, help="external device addresses")
+    platform: str = field("", help="jax platform override")
+    cpu_devices: int = field(0, help="virtual CPU devices for --platform cpu")
+    data_dir: str = field("data/mnist", help="IDX data directory")
+
+
+INPUT_ADDR = 0x10000
+LOGITS_ADDR = 0x20000
+
+
+def main(argv=None):
+    cfg = WireConfig.parse_args(argv)
+    from dsml_tpu.utils.platform import configure_platform
+
+    configure_platform(cfg.platform, cfg.cpu_devices)
+    import jax
+
+    from dsml_tpu.comm.client import GRAD_ADDR, WEIGHTS_ADDR, PipelineClient, bytes_to_f32
+    from dsml_tpu.comm.coordinator import serve_coordinator
+    from dsml_tpu.comm.device_server import serve_local_devices
+    from dsml_tpu.comm.proto import gpu_sim_pb2 as pb
+    from dsml_tpu.models.mlp import MLP
+    from dsml_tpu.utils.data import load_mnist, shard_batches
+    from dsml_tpu.utils.logging import get_logger
+
+    log = get_logger("wire-train")
+    model = MLP()  # 784-128-64-10
+
+    handles = []
+    coordinator = None
+    if cfg.coordinator:
+        coord_addr, device_addrs = cfg.coordinator, list(cfg.devices)
+    else:
+        n = cfg.n_devices or len(jax.devices())
+        handles = serve_local_devices(n, mem_size=0x1000000, model=model)
+        coordinator = serve_coordinator()
+        coord_addr, device_addrs = coordinator.address, [h.address for h in handles]
+
+    client = PipelineClient.connect(coord_addr, device_addrs)
+    n_ranks = len(client.devices)
+    data = load_mnist(cfg.data_dir)
+    params = model.init(0)
+    flat = np.asarray(model.flatten(params), np.float32)
+    n_out = model.sizes[-1]
+
+    t0 = time.monotonic()
+    client.broadcast_weights(flat, WEIGHTS_ADDR)
+    for epoch in range(1, cfg.epochs + 1):
+        losses = []
+        for x, y in shard_batches(data.train_x, data.train_y, cfg.batch_size, seed=epoch):
+            shard = x.shape[0] // n_ranks
+            if shard == 0:
+                continue
+            dlogits_bytes = []
+            for r in range(n_ranks):
+                client.write(r, INPUT_ADDR, x[r * shard : (r + 1) * shard])
+                client.run_forward(r, INPUT_ADDR, LOGITS_ADDR)
+            for r in range(n_ranks):
+                logits = bytes_to_f32(client.read(r, LOGITS_ADDR, shard * n_out * 4)).reshape(shard, n_out)
+                ys = y[r * shard : (r + 1) * shard]
+                # softmax cross-entropy gradient wrt logits, mean over shard
+                z = logits - logits.max(axis=1, keepdims=True)
+                p = np.exp(z) / np.exp(z).sum(axis=1, keepdims=True)
+                losses.append(float(-np.log(p[np.arange(shard), ys] + 1e-12).mean()))
+                d = p
+                d[np.arange(shard), ys] -= 1.0
+                dlogits_bytes.append((d / shard).astype(np.float32))
+            for r in range(n_ranks):
+                client.write(r, GRAD_ADDR, dlogits_bytes[r])
+                client.run_backward(r, GRAD_ADDR)
+            client.all_reduce_ring(
+                flat.nbytes, op=pb.AVG, mem_addrs={r: GRAD_ADDR for r in range(n_ranks)}
+            )
+            grads = bytes_to_f32(client.read(0, GRAD_ADDR, flat.nbytes))
+            flat = flat - cfg.lr * grads
+            client.broadcast_weights(flat, WEIGHTS_ADDR)
+        log.info("Epoch %d: Average Loss = %.4f", epoch, float(np.mean(losses)))
+
+    # test accuracy with the final weights, on-host
+    params = model.unflatten(np.asarray(flat))
+    import jax.numpy as jnp
+
+    acc = float(np.mean(np.asarray(jnp.argmax(model.apply(params, jnp.asarray(data.test_x)), -1)) == data.test_y))
+    log.info("Final Test Accuracy: %.2f%% (wall %.1fs)", acc * 100, time.monotonic() - t0)
+
+    client.finalize()
+    if coordinator is not None:
+        coordinator.stop()
+    for h in handles:
+        h.stop()
+    return acc
+
+
+if __name__ == "__main__":
+    main()

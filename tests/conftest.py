@@ -44,3 +44,11 @@ def mesh8(devices8):
     from jax.sharding import Mesh
 
     return Mesh(np.asarray(devices8).reshape(8), ("dev",))
+
+
+@pytest.fixture(scope="session")
+def dp_mesh8(devices8):
+    """Framework-shaped mesh (pp/dp/fsdp/sp/tp axes) with dp=8."""
+    from dsml_tpu.parallel.mesh import data_mesh
+
+    return data_mesh(devices=devices8)
