@@ -1,0 +1,77 @@
+"""MNIST CNN (2×conv + 2×fc) — BASELINE.json config #3.
+
+Same protocol as the other models (``init``/``apply``/``loss``) so the
+data-parallel Trainer and the 8-device psum gradient sync drive it
+unchanged. Convs lower to ``lax.conv_general_dilated`` in NHWC, which XLA
+maps onto the MXU as implicit GEMMs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["CNN"]
+
+
+class CNN:
+    """conv3x3(32) → pool → conv3x3(64) → pool → fc(128) → fc(classes)."""
+
+    def __init__(self, image_size: int = 28, channels: int = 1, classes: int = 10,
+                 conv_features: tuple[int, int] = (32, 64), fc_width: int = 128):
+        self.image_size = image_size
+        self.channels = channels
+        self.classes = classes
+        self.conv_features = conv_features
+        self.fc_width = fc_width
+        self._flat = (image_size // 4) * (image_size // 4) * conv_features[1]
+
+    def init(self, seed: int = 0) -> dict:
+        from dsml_tpu.models.common import he_init
+
+        rng = np.random.default_rng(seed)
+
+        def he(*shape, fan_in):
+            return he_init(rng, *shape, fan_in=fan_in)
+
+        c1, c2 = self.conv_features
+        return {
+            "conv1": {"w": he(3, 3, self.channels, c1, fan_in=9 * self.channels), "b": jnp.zeros(c1)},
+            "conv2": {"w": he(3, 3, c1, c2, fan_in=9 * c1), "b": jnp.zeros(c2)},
+            "fc1": {"w": he(self._flat, self.fc_width, fan_in=self._flat), "b": jnp.zeros(self.fc_width)},
+            "fc2": {"w": he(self.fc_width, self.classes, fan_in=self.fc_width), "b": jnp.zeros(self.classes)},
+        }
+
+    def apply(self, params: dict, x: jax.Array) -> jax.Array:
+        if x.ndim == 2:  # flat pixels → NHWC
+            x = x.reshape(-1, self.image_size, self.image_size, self.channels)
+
+        def conv(p, t):
+            return lax.conv_general_dilated(
+                t, p["w"], window_strides=(1, 1), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ) + p["b"]
+
+        def pool(t):
+            return lax.reduce_window(t, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+
+        h = pool(jax.nn.relu(conv(params["conv1"], x)))
+        h = pool(jax.nn.relu(conv(params["conv2"], h)))
+        h = h.reshape(h.shape[0], -1)
+        h = jax.nn.relu(h @ params["fc1"]["w"] + params["fc1"]["b"])
+        return h @ params["fc2"]["w"] + params["fc2"]["b"]
+
+    def loss(self, params: dict, x: jax.Array, y: jax.Array) -> jax.Array:
+        from dsml_tpu.models.common import softmax_xent
+
+        return softmax_xent(self.apply(params, x), y)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def accuracy_count(self, params, x, y):
+        from dsml_tpu.models.common import count_correct
+
+        return count_correct(self.apply(params, x), y)

@@ -1,0 +1,24 @@
+"""Shared model utilities: initializers and classification losses."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["he_init", "softmax_xent", "count_correct"]
+
+
+def he_init(rng: np.random.Generator, *shape: int, fan_in: int) -> jax.Array:
+    """He-normal initialization (scale sqrt(2/fan_in)), float32."""
+    return jnp.asarray(rng.standard_normal(shape) * np.sqrt(2.0 / fan_in), jnp.float32)
+
+
+def softmax_xent(logits: jax.Array, y: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy over integer labels."""
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+
+def count_correct(logits: jax.Array, y: jax.Array) -> jax.Array:
+    return jnp.sum(jnp.argmax(logits, axis=-1) == y)

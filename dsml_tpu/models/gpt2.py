@@ -1,0 +1,359 @@
+"""GPT-2 — the flagship transformer, designed as an SPMD mesh program.
+
+BASELINE.md's top config is "TinyStories GPT-2-small (125M), data-parallel +
+grad accumulation"; the reference itself never got past an MLP (SURVEY.md
+§2.3), with TP/SP/hybrid existing only in its literature corpus (Megatron
+PTD-P, Ring Self-Attention, LoongTrain 2D attention). This module implements
+that roadmap TPU-first:
+
+- **TP** (Megatron-style): QKV/MLP-in weights column-sharded, out-projections
+  row-sharded over the ``tp`` axis, ONE ``psum`` per attention block and one
+  per MLP block; the unembedding is vocab-sharded with a
+  distributed-logsumexp cross-entropy so full logits never materialize.
+- **SP/CP**: the sequence axis is sharded over ``sp``; attention runs as ring
+  attention (``ppermute`` K/V rotation) or Ulysses (all-to-all head
+  re-shard) — LoongTrain's 2D head×context grid is exactly ``tp × sp`` here.
+- **DP**: batch axis sharded over ``dp``; gradients ``psum`` over (dp, sp).
+- **EP (MoE)**: optionally the MLP is a top-k-gated expert layer with experts
+  sharded over ``tp`` and token dispatch via ``all_to_all``.
+
+Everything below is shape-static, scan-free Python-loop-over-layers (unrolled
+by trace), bf16-friendly, and runs under ``jax.shard_map`` on the framework
+mesh (``dsml_tpu.parallel.mesh``). ``apply``/``loss`` (no axis names) give
+the plain single-device semantics used for parity tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dsml_tpu.ops.attention import attention, ring_attention, ulysses_attention
+
+__all__ = ["GPT2Config", "GPT2"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    max_seq: int = 1024
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    dtype: str = "float32"  # params/activations dtype ("bfloat16" for TPU runs)
+    # MoE: 0 experts = dense MLP; otherwise top-k gated expert layer
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+
+    @staticmethod
+    def small() -> "GPT2Config":
+        """GPT-2-small, 125M params (the BASELINE config)."""
+        return GPT2Config()
+
+    @staticmethod
+    def tiny(vocab_size: int = 512, n_experts: int = 0) -> "GPT2Config":
+        """Test-sized config that still exercises every code path."""
+        return GPT2Config(
+            vocab_size=vocab_size, max_seq=128, n_layer=2, n_head=8, d_model=64, d_ff=128,
+            n_experts=n_experts,
+        )
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    return ((x32 - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale + bias
+
+
+class GPT2:
+    """Decoder-only transformer with mesh-aware sharding rules."""
+
+    def __init__(self, config: GPT2Config | None = None):
+        self.config = config or GPT2Config.small()
+
+    # ---- params ---------------------------------------------------------------
+
+    def init(self, seed: int = 0) -> dict:
+        cfg = self.config
+        rng = np.random.default_rng(seed)
+        dt = jnp.dtype(cfg.dtype)
+
+        def normal(*shape, std=0.02):
+            return jnp.asarray(rng.standard_normal(shape) * std, dt)
+
+        def zeros(*shape):
+            return jnp.zeros(shape, dt)
+
+        # GPT-2 scales residual-path projections by 1/sqrt(2*n_layer)
+        res_std = 0.02 / math.sqrt(2 * cfg.n_layer)
+        params = {
+            "wte": normal(cfg.vocab_size, cfg.d_model),
+            "wpe": normal(cfg.max_seq, cfg.d_model, std=0.01),
+            "ln_f": {"scale": jnp.ones(cfg.d_model, dt), "bias": zeros(cfg.d_model)},
+            "layers": [],
+        }
+        for _ in range(cfg.n_layer):
+            layer = {
+                "ln_1": {"scale": jnp.ones(cfg.d_model, dt), "bias": zeros(cfg.d_model)},
+                "ln_2": {"scale": jnp.ones(cfg.d_model, dt), "bias": zeros(cfg.d_model)},
+                # wqkv is [d, 3, d] with the LAST dim TP-sharded: a contiguous
+                # column shard of a fused [d, 3d] matrix would hand each rank
+                # a mix of q/k/v columns and scramble the head assignment
+                "attn": {
+                    "wqkv": normal(cfg.d_model, 3, cfg.d_model),
+                    "bqkv": zeros(3, cfg.d_model),
+                    "wo": normal(cfg.d_model, cfg.d_model, std=res_std),
+                    "bo": zeros(cfg.d_model),
+                },
+            }
+            if cfg.n_experts:
+                layer["moe"] = {
+                    "gate": normal(cfg.d_model, cfg.n_experts),
+                    "w_in": normal(cfg.n_experts, cfg.d_model, cfg.d_ff),
+                    "b_in": zeros(cfg.n_experts, cfg.d_ff),
+                    "w_out": normal(cfg.n_experts, cfg.d_ff, cfg.d_model, std=res_std),
+                    "b_out": zeros(cfg.n_experts, cfg.d_model),
+                }
+            else:
+                layer["mlp"] = {
+                    "w_in": normal(cfg.d_model, cfg.d_ff),
+                    "b_in": zeros(cfg.d_ff),
+                    "w_out": normal(cfg.d_ff, cfg.d_model, std=res_std),
+                    "b_out": zeros(cfg.d_model),
+                }
+            params["layers"].append(layer)
+        return params
+
+    def n_params(self, params) -> int:
+        return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+    # ---- sharding rules (GSPMD specs over the framework mesh axes) -------------
+
+    def param_specs(self) -> dict:
+        """PartitionSpec pytree: Megatron TP sharding over 'tp', everything
+        else replicated (dp/sp replicate params; fsdp would further shard —
+        see parallel.fsdp)."""
+        from jax.sharding import PartitionSpec as P
+
+        cfg = self.config
+        layer_spec = {
+            "ln_1": {"scale": P(), "bias": P()},
+            "ln_2": {"scale": P(), "bias": P()},
+            "attn": {
+                "wqkv": P(None, None, "tp"),  # column-parallel (heads split)
+                "bqkv": P(None, "tp"),
+                "wo": P("tp", None),  # row-parallel
+                "bo": P(),
+            },
+        }
+        if cfg.n_experts:
+            layer_spec["moe"] = {
+                "gate": P(),
+                "w_in": P("tp", None, None),  # experts sharded over tp
+                "b_in": P("tp", None),
+                "w_out": P("tp", None, None),
+                "b_out": P("tp", None),
+            }
+        else:
+            layer_spec["mlp"] = {
+                "w_in": P(None, "tp"),
+                "b_in": P("tp"),
+                "w_out": P("tp", None),
+                "b_out": P(),
+            }
+        return {
+            "wte": P("tp", None),  # vocab-sharded embedding/unembedding
+            "wpe": P(),
+            "ln_f": {"scale": P(), "bias": P()},
+            "layers": [layer_spec for _ in range(cfg.n_layer)],
+        }
+
+    # ---- forward (per-rank SPMD function; axis names optional) -----------------
+
+    def apply_spmd(
+        self,
+        params: dict,
+        tokens: jax.Array,  # [batch_shard, seq_shard] int32
+        tp_axis: str | None = None,
+        sp_axis: str | None = None,
+        attn_impl: str = "ring",
+        seq_offset: int | None = None,
+    ) -> jax.Array:
+        """Per-rank forward to vocab-shard logits.
+
+        Under shard_map: ``tokens`` is this rank's (batch, sequence) shard;
+        weights arrive TP-sharded per :meth:`param_specs`. Returns logits
+        sharded over tp on the vocab dim: [batch_shard, seq_shard, vocab/tp].
+        """
+        cfg = self.config
+        tp_size = lax.axis_size(tp_axis) if tp_axis else 1
+        if cfg.n_head % tp_size:
+            raise ValueError(f"n_head={cfg.n_head} not divisible by tp={tp_size}")
+        n_head_local = cfg.n_head // tp_size
+        seq_local = tokens.shape[1]
+        if sp_axis:
+            sp_rank = lax.axis_index(sp_axis)
+            pos = sp_rank * seq_local + jnp.arange(seq_local)
+        else:
+            pos = jnp.arange(seq_local) + (seq_offset or 0)
+
+        # embedding: wte is vocab-sharded over tp → masked gather + psum
+        # (each token's row lives on exactly one shard)
+        if tp_axis:
+            vocab_shard = params["wte"].shape[0]
+            tp_rank = lax.axis_index(tp_axis)
+            local_ids = tokens - tp_rank * vocab_shard
+            in_shard = (local_ids >= 0) & (local_ids < vocab_shard)
+            safe_ids = jnp.clip(local_ids, 0, vocab_shard - 1)
+            h = lax.psum(params["wte"][safe_ids] * in_shard[..., None], tp_axis)
+        else:
+            h = params["wte"][tokens]
+        h = h + params["wpe"][pos]
+
+        for layer in params["layers"]:
+            h = h + self._attn_block(layer, h, n_head_local, tp_axis, sp_axis, attn_impl)
+            if cfg.n_experts:
+                h = h + self._moe_block(layer["moe"], _layer_norm(h, **layer["ln_2"]), tp_axis)
+            else:
+                h = h + self._mlp_block(layer["mlp"], _layer_norm(h, **layer["ln_2"]), tp_axis)
+
+        h = _layer_norm(h, **params["ln_f"])
+        return h @ params["wte"].T  # tied unembedding → [b, s, vocab/tp]
+
+    def _attn_block(self, layer, h, n_head_local, tp_axis, sp_axis, attn_impl):
+        cfg = self.config
+        x = _layer_norm(h, **layer["ln_1"])
+        # wqkv local shard: [d, 3, d/tp] — slot axis separates q/k/v so the
+        # TP shard on the last dim is purely a head split
+        qkv = jnp.einsum("bsd,dke->bske", x, layer["attn"]["wqkv"]) + layer["attn"]["bqkv"]
+        d_local = n_head_local * (cfg.d_model // cfg.n_head)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+        def heads(t):  # [b, s, d_local] -> [b, h_local, s, hd]
+            b, s, _ = t.shape
+            return t.reshape(b, s, n_head_local, -1).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if sp_axis and attn_impl == "ring":
+            out = ring_attention(q, k, v, sp_axis, causal=True)
+        elif sp_axis and attn_impl == "ulysses":
+            out = ulysses_attention(q, k, v, sp_axis, causal=True)
+        else:
+            out = attention(q, k, v, causal=True)
+        b, _, s, _ = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, d_local)
+        out = out @ layer["attn"]["wo"]  # row-parallel → partial sums
+        if tp_axis:
+            out = lax.psum(out, tp_axis)  # Megatron psum #1
+        return out + layer["attn"]["bo"]
+
+    def _mlp_block(self, mlp, x, tp_axis):
+        hmid = jax.nn.gelu(x @ mlp["w_in"] + mlp["b_in"])  # [b, s, d_ff/tp]
+        out = hmid @ mlp["w_out"]
+        if tp_axis:
+            out = lax.psum(out, tp_axis)  # Megatron psum #2
+        return out + mlp["b_out"]
+
+    def _moe_block(self, moe, x, tp_axis):
+        """Top-k gated mixture of experts with experts sharded over
+        ``tp_axis`` (expert parallelism). Activations are replicated across
+        tp (Megatron invariant), so every rank routes identically, processes
+        only its resident expert shard, and the partial outputs ``psum`` —
+        expert parallelism with the same one-collective cost shape as the
+        dense MLP. Switch-style dense dispatch (static shapes, capacity-
+        bounded, overflow dropped) keeps everything MXU-friendly."""
+        cfg = self.config
+        b, s, d = x.shape
+        n_exp = cfg.n_experts
+        ep = lax.axis_size(tp_axis) if tp_axis else 1
+        exp_local = n_exp // ep
+        if exp_local * ep != n_exp:
+            raise ValueError(f"n_experts={n_exp} not divisible by tp={ep}")
+        tokens = x.reshape(-1, d)  # [T, d]
+        t = tokens.shape[0]
+
+        gate_logits = tokens @ moe["gate"].astype(tokens.dtype)  # [T, E]
+        gate_probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+        top_p, top_e = lax.top_k(gate_probs, cfg.expert_top_k)  # [T, k]
+        top_p = (top_p / top_p.sum(-1, keepdims=True)).astype(x.dtype)
+
+        capacity = int(cfg.capacity_factor * t * cfg.expert_top_k / n_exp) + 1
+        flat_e = top_e.reshape(-1)  # [T*k], expert id per assignment
+        eo = jax.nn.one_hot(flat_e, n_exp, dtype=jnp.int32)  # [T*k, E]
+        # position of each assignment within its expert's capacity buffer
+        pos_in_expert = ((jnp.cumsum(eo, axis=0) - eo) * eo).sum(-1)
+        keep = pos_in_expert < capacity
+        disp = (
+            jax.nn.one_hot(flat_e, n_exp, dtype=x.dtype)[:, :, None]
+            * jax.nn.one_hot(pos_in_expert, capacity, dtype=x.dtype)[:, None, :]
+            * keep[:, None, None]
+        ).reshape(t, cfg.expert_top_k, n_exp, capacity)
+        combine = (disp * top_p.reshape(t, cfg.expert_top_k)[:, :, None, None]).sum(1)  # [T, E, C]
+        disp = disp.sum(1)  # [T, E, C]
+
+        if ep > 1:
+            r = lax.axis_index(tp_axis)
+            disp = lax.dynamic_slice_in_dim(disp, r * exp_local, exp_local, axis=1)
+            combine = lax.dynamic_slice_in_dim(combine, r * exp_local, exp_local, axis=1)
+
+        expert_in = jnp.einsum("td,tec->ecd", tokens, disp)  # [E_local, C, d]
+        hmid = jax.nn.gelu(
+            jnp.einsum("ecd,edf->ecf", expert_in, moe["w_in"]) + moe["b_in"][:, None, :]
+        )
+        expert_out = jnp.einsum("ecf,efd->ecd", hmid, moe["w_out"]) + moe["b_out"][:, None, :]
+        out = jnp.einsum("ecd,tec->td", expert_out, combine)
+        if ep > 1:
+            out = lax.psum(out, tp_axis)
+        return out.reshape(b, s, d)
+
+    # ---- loss ------------------------------------------------------------------
+
+    def loss_spmd(
+        self,
+        params: dict,
+        tokens: jax.Array,
+        targets: jax.Array,
+        tp_axis: str | None = None,
+        sp_axis: str | None = None,
+        attn_impl: str = "ring",
+    ) -> jax.Array:
+        """Mean next-token cross-entropy with vocab-sharded logits: the full
+        [.., vocab] row never exists on one chip — logsumexp and the target
+        logit are combined across the tp axis."""
+        logits = self.apply_spmd(params, tokens, tp_axis, sp_axis, attn_impl).astype(jnp.float32)
+        if not tp_axis:
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+            return nll.mean()
+        vocab_shard = logits.shape[-1]
+        tp_rank = lax.axis_index(tp_axis)
+        # distributed logsumexp (max-shift carries no gradient, and pmax has
+        # no VJP rule — stop_gradient on both)
+        local_max = lax.stop_gradient(logits.max(-1, keepdims=True))
+        global_max = lax.stop_gradient(lax.pmax(local_max, tp_axis))
+        sumexp = jnp.sum(jnp.exp(logits - global_max), axis=-1, keepdims=True)
+        lse = jnp.log(lax.psum(sumexp, tp_axis)) + global_max  # [b, s, 1]
+        # target logit lives on exactly one shard
+        local_ids = targets - tp_rank * vocab_shard
+        in_shard = (local_ids >= 0) & (local_ids < vocab_shard)
+        safe_ids = jnp.clip(local_ids, 0, vocab_shard - 1)
+        tgt = jnp.take_along_axis(logits, safe_ids[..., None], axis=-1)
+        tgt = lax.psum(jnp.where(in_shard[..., None], tgt, 0.0), tp_axis)
+        return jnp.mean(lse - tgt)
+
+    # ---- single-device conveniences (parity + Trainer protocol) ----------------
+
+    def apply(self, params: dict, tokens: jax.Array) -> jax.Array:
+        return self.apply_spmd(params, tokens)
+
+    def loss(self, params: dict, tokens: jax.Array, targets: jax.Array) -> jax.Array:
+        return self.loss_spmd(params, tokens, targets)

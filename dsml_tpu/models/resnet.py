@@ -1,0 +1,108 @@
+"""ResNet-18 for CIFAR-10 — BASELINE.json config #4.
+
+CIFAR-style ResNet-18 (3x3 stem, no max-pool, 4 stages × 2 basic blocks,
+[64, 128, 256, 512] channels). Normalization is batch-stat BatchNorm
+evaluated in "train mode" at all times: statistics come from the current
+batch, so the model stays a pure function of (params, batch) — no mutable
+running-stat state to thread through jit/shard_map, and under data
+parallelism each shard normalizes over its local batch (what sync-free BN
+does on real multi-chip runs). Pair with the cosine LR schedule in
+TrainConfig for the "ring AllReduce + adaptive LR scheduler" baseline row.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["ResNet18"]
+
+
+def _conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _batch_norm(x, scale, bias, eps=1e-5):
+    mean = x.mean(axis=(0, 1, 2))
+    var = x.var(axis=(0, 1, 2))
+    return (x - mean) * lax.rsqrt(var + eps) * scale + bias
+
+
+class ResNet18:
+    STAGES = (64, 128, 256, 512)
+    BLOCKS_PER_STAGE = 2
+
+    def __init__(self, classes: int = 10, channels: int = 3):
+        self.classes = classes
+        self.channels = channels
+
+    # ---- params ---------------------------------------------------------------
+
+    def init(self, seed: int = 0) -> dict:
+        from dsml_tpu.models.common import he_init
+
+        rng = np.random.default_rng(seed)
+
+        def he(*shape, fan_in):
+            return he_init(rng, *shape, fan_in=fan_in)
+
+        def bn(c):
+            return {"scale": jnp.ones(c), "bias": jnp.zeros(c)}
+
+        params = {
+            "stem": {"w": he(3, 3, self.channels, 64, fan_in=9 * self.channels), "bn": bn(64)},
+            "stages": [],
+            "fc": {"w": he(512, self.classes, fan_in=512), "b": jnp.zeros(self.classes)},
+        }
+        in_c = 64
+        for out_c in self.STAGES:
+            blocks = []
+            for b in range(self.BLOCKS_PER_STAGE):
+                stride = 2 if (b == 0 and out_c != 64) else 1
+                block = {
+                    "conv1": {"w": he(3, 3, in_c, out_c, fan_in=9 * in_c), "bn": bn(out_c)},
+                    "conv2": {"w": he(3, 3, out_c, out_c, fan_in=9 * out_c), "bn": bn(out_c)},
+                }
+                if stride != 1 or in_c != out_c:
+                    block["down"] = {"w": he(1, 1, in_c, out_c, fan_in=in_c), "bn": bn(out_c)}
+                blocks.append(block)
+                in_c = out_c
+            params["stages"].append(blocks)
+        return params
+
+    # ---- forward --------------------------------------------------------------
+
+    def apply(self, params: dict, x: jax.Array) -> jax.Array:
+        if x.ndim == 2:  # flat → NHWC (32x32x3 CIFAR)
+            side = int(np.sqrt(x.shape[1] // self.channels))
+            x = x.reshape(-1, side, side, self.channels)
+        h = jax.nn.relu(_batch_norm(_conv(x, params["stem"]["w"]), **params["stem"]["bn"]))
+        for s, blocks in enumerate(params["stages"]):
+            for b, block in enumerate(blocks):
+                stride = 2 if (b == 0 and s != 0) else 1
+                r = jax.nn.relu(_batch_norm(_conv(h, block["conv1"]["w"], stride), **block["conv1"]["bn"]))
+                r = _batch_norm(_conv(r, block["conv2"]["w"]), **block["conv2"]["bn"])
+                shortcut = h
+                if "down" in block:
+                    shortcut = _batch_norm(_conv(h, block["down"]["w"], stride), **block["down"]["bn"])
+                h = jax.nn.relu(r + shortcut)
+        h = h.mean(axis=(1, 2))  # global average pool
+        return h @ params["fc"]["w"] + params["fc"]["b"]
+
+    def loss(self, params: dict, x: jax.Array, y: jax.Array) -> jax.Array:
+        from dsml_tpu.models.common import softmax_xent
+
+        return softmax_xent(self.apply(params, x), y)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def accuracy_count(self, params, x, y):
+        from dsml_tpu.models.common import count_correct
+
+        return count_correct(self.apply(params, x), y)

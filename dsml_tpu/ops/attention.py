@@ -1,0 +1,132 @@
+"""Attention ops: causal MHA, ring attention (SP), Ulysses all-to-all (CP).
+
+Long-context scaling exists in the reference only as curated literature
+(SURVEY.md §5.7): Ring Self-Attention (Li et al., ACL'23 — K/V blocks walk a
+device ring) and LoongTrain's 2D attention (head-parallel × context-parallel
+grids). Both are realized here as first-class mesh programs:
+
+- :func:`attention` — plain fused softmax(QKᵀ)V with causal masking; XLA maps
+  the batched matmuls straight onto the MXU.
+- :func:`ring_attention` — sequence-parallel blockwise attention: each rank
+  holds a sequence shard, K/V shards rotate ``n-1`` hops via ``ppermute``
+  (the exact ring schedule the reference used for gradient bytes,
+  ``gpu_coordinator_server.go:393-419``, lifted to attention blocks), with
+  numerically-stable online-softmax accumulation so the result is exactly
+  full attention.
+- :func:`ulysses_attention` — all-to-all re-shard: sequence-sharded →
+  head-sharded before attention, back after (DeepSpeed-Ulysses / LoongTrain
+  head-parallelism), for meshes where an all-to-all beats n-1 ring hops.
+
+All three agree numerically; tests assert it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["attention", "ring_attention", "ulysses_attention"]
+
+_NEG_INF = -1e30
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True) -> jax.Array:
+    """Scaled dot-product attention. Shapes: [batch, heads, seq, head_dim]."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        scores = jnp.where(mask, scores, _NEG_INF)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
+
+
+def _block_scores(q, k, scale, causal, q_offset, k_offset, seq_block):
+    """Scores for one (query-shard, key-shard) pair with global causal mask."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        q_pos = q_offset * seq_block + jnp.arange(q.shape[-2])
+        k_pos = k_offset * seq_block + jnp.arange(k.shape[-2])
+        scores = jnp.where(q_pos[:, None] >= k_pos[None, :], scores, _NEG_INF)
+    return scores
+
+
+def ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str, causal: bool = True
+) -> jax.Array:
+    """Exact attention over a sequence sharded along ``axis_name``.
+
+    Call under ``shard_map`` with q/k/v = this rank's sequence shard
+    [batch, heads, seq/n, head_dim]. K/V rotate around the ring while each
+    rank folds every visiting block into a running online-softmax
+    accumulator (numerator, denominator, row-max) — attention never
+    materializes the full [seq, seq] score matrix on any chip, which is what
+    makes 100k+-token sequences fit (Ring Self-Attention; SURVEY.md §5.7).
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return attention(q, k, v, causal)
+    rank = lax.axis_index(axis_name)
+    seq_block = q.shape[-2]
+    scale = q.shape[-1] ** -0.5
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def fold(carry, kv_block, k_offset):
+        num, den, row_max = carry
+        k_blk, v_blk = kv_block
+        scores = _block_scores(q, k_blk, scale, causal, rank, k_offset, seq_block)
+        blk_max = jnp.max(scores, axis=-1, keepdims=True)
+        new_max = jnp.maximum(row_max, blk_max)
+        # rescale previous accumulators to the new max, then add this block
+        correction = jnp.exp(row_max - new_max)
+        p = jnp.exp(scores - new_max)
+        num = num * correction + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+        den = den * correction + jnp.sum(p, axis=-1, keepdims=True)
+        return (num, den, new_max)
+
+    num = jnp.zeros_like(q)
+    den = jnp.zeros(q.shape[:-1] + (1,), q.dtype)
+    # floor at -1e20 (not -inf/-1e30): a fully-causal-masked block has
+    # blk_max = -1e30, and an unfloored running max would make
+    # exp(scores - max) = exp(0) = 1 for masked positions.
+    row_max = jnp.full(q.shape[:-1] + (1,), -1e20, q.dtype)
+
+    kv = (k, v)
+    carry = (num, den, row_max)
+    # n hops: fold the resident block, then rotate K/V to the next rank.
+    for hop in range(n):
+        k_offset = (rank - hop) % n  # whose K/V block is resident this hop
+        carry = fold(carry, kv, k_offset)
+        if hop != n - 1:
+            kv = jax.tree.map(lambda t: lax.ppermute(t, axis_name, perm), kv)
+    num, den, _ = carry
+    return num / jnp.maximum(den, 1e-30)
+
+
+def ulysses_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str, causal: bool = True
+) -> jax.Array:
+    """Exact attention via all-to-all head/sequence re-sharding.
+
+    Enter with sequence-sharded blocks [batch, heads, seq/n, head_dim];
+    one all-to-all flips to head-sharded full sequences
+    [batch, heads/n, seq, head_dim], plain attention runs locally, a second
+    all-to-all flips back. Requires heads % axis_size == 0.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return attention(q, k, v, causal)
+    if q.shape[1] % n:
+        raise ValueError(f"heads ({q.shape[1]}) not divisible by axis size {n}")
+
+    def seq_to_heads(t):  # [b, h, s/n, d] -> [b, h/n, s, d]
+        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def heads_to_seq(t):  # [b, h/n, s, d] -> [b, h, s/n, d]
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    out = attention(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal)
+    return heads_to_seq(out)

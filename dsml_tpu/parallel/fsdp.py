@@ -1,0 +1,70 @@
+"""FSDP / ZeRO-style parameter sharding over the ``fsdp`` mesh axis.
+
+Memory-efficiency capability (reference: literature only — SURVEY.md §2.4
+"7. Memory/"). TPU-idiomatic formulation: instead of hand-rolling gather/
+scatter, each parameter leaf is *annotated* as sharded on its largest
+divisible axis over ``fsdp``; XLA's SPMD partitioner then materializes
+weights via all-gather just-in-time per layer and reduce-scatters gradients
+— the ZeRO-3 communication pattern, derived by the compiler from sharding
+annotations alone. Optimizer state inherits the same sharding (ZeRO-1/2 come
+along for free: moments live sharded).
+"""
+
+from __future__ import annotations
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["fsdp_shardings", "shard_params_fsdp", "make_fsdp_train_step"]
+
+
+def fsdp_shardings(params, mesh: Mesh, axis: str = "fsdp"):
+    """NamedSharding pytree: each leaf sharded over ``axis`` on its first
+    dimension divisible by the axis size (replicated when none is)."""
+    size = mesh.shape[axis]
+
+    def spec_for(leaf):
+        for dim, n in enumerate(leaf.shape):
+            if n % size == 0 and n >= size:
+                return NamedSharding(mesh, P(*([None] * dim + [axis])))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(spec_for, params)
+
+
+def shard_params_fsdp(params, mesh: Mesh, axis: str = "fsdp"):
+    return jax.tree.map(jax.device_put, params, fsdp_shardings(params, mesh, axis))
+
+
+def make_fsdp_train_step(
+    loss_fn,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    batch_axes: tuple[str, ...] = ("dp", "fsdp"),
+):
+    """jitted ``step(params, opt_state, x, y)`` with params FSDP-sharded and
+    the batch sharded over ``batch_axes`` (fsdp doubles as a data axis, as in
+    ZeRO: every rank computes on its batch shard with gathered weights).
+    XLA inserts the all-gather/reduce-scatter schedule from the shardings."""
+    batch_sh = NamedSharding(mesh, P(batch_axes))
+
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    jitted = jax.jit(step, donate_argnums=(0, 1))
+
+    def run(params, opt_state, x, y):
+        x = jax.device_put(x, batch_sh)
+        y = jax.device_put(y, batch_sh)
+        return jitted(params, opt_state, x, y)
+
+    return run
+
+
+def init_fsdp(model, optimizer, mesh: Mesh, seed: int = 0, axis: str = "fsdp"):
+    params = shard_params_fsdp(model.init(seed), mesh, axis)
+    opt_state = jax.jit(optimizer.init)(params)
+    return params, opt_state

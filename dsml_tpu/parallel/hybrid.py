@@ -1,0 +1,109 @@
+"""Hybrid-parallel training: dp × sp × tp in one jitted mesh program.
+
+The composable-mesh-axes design the reference's literature corpus points at
+(Megatron PTD-P, OneFlow SBP, Colossal-AI — SURVEY.md §2.3 "hybrid
+parallelism: literature only") realized for the transformer:
+
+- params enter TP-sharded (``GPT2.param_specs``), replicated over dp/sp;
+- the batch enters ``P('dp', 'sp')`` (batch rows over dp, sequence over sp);
+- inside ``shard_map``, the model runs Megatron TP psums + ring/Ulysses
+  sequence-parallel attention; gradients ``pmean`` over (dp, sp);
+- the optimizer update runs OUTSIDE shard_map in the same jit — GSPMD
+  propagates the param shardings through optax states automatically.
+
+One step = one XLA program; every collective rides ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["shard_params", "make_hybrid_train_step", "hybrid_loss_fn"]
+
+
+def shard_params(params, mesh: Mesh, specs) -> dict:
+    """Place a param pytree onto the mesh per its PartitionSpec pytree."""
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def hybrid_loss_fn(model, attn_impl: str = "ring") -> Callable:
+    """Per-rank loss closure for shard_map over the framework mesh axes."""
+
+    def loss_fn(params, x, y):
+        return model.loss_spmd(params, x, y, tp_axis="tp", sp_axis="sp", attn_impl=attn_impl)
+
+    return loss_fn
+
+
+def make_hybrid_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    attn_impl: str = "ring",
+    grad_accum: int = 1,
+):
+    """Build ``step(params, opt_state, x, y) -> (params, opt_state, loss)``.
+
+    ``x``/``y``: int32 [global_batch, seq]; with ``grad_accum > 1`` the
+    global batch is split into that many microbatches whose gradients
+    accumulate on-device before one optimizer update (BASELINE.md's
+    "data-parallel AllReduce + grad accumulation" config).
+    """
+    pspecs = model.param_specs()
+    batch_spec = P("dp", "sp")
+    loss_fn = hybrid_loss_fn(model, attn_impl)
+
+    def grads_fn(params, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        grads = jax.tree.map(lambda g: lax.pmean(g, ("dp", "sp")), grads)
+        return lax.pmean(loss, ("dp", "sp")), grads
+
+    sharded_grads = jax.shard_map(
+        grads_fn,
+        mesh=mesh,
+        in_specs=(pspecs, batch_spec, batch_spec),
+        out_specs=(P(), pspecs),
+        check_vma=False,
+    )
+
+    def step(params, opt_state, x, y):
+        if grad_accum == 1:
+            loss, grads = sharded_grads(params, x, y)
+        else:
+            if x.shape[0] % grad_accum:
+                raise ValueError(
+                    f"global batch {x.shape[0]} not divisible by grad_accum={grad_accum}"
+                )
+            micro = x.shape[0] // grad_accum
+            xs = x[: micro * grad_accum].reshape(grad_accum, micro, *x.shape[1:])
+            ys = y[: micro * grad_accum].reshape(grad_accum, micro, *y.shape[1:])
+
+            def body(carry, xy):
+                loss_acc, grads_acc = carry
+                loss, grads = sharded_grads(params, *xy)
+                return (loss_acc + loss, jax.tree.map(jax.numpy.add, grads_acc, grads)), None
+
+            zero = jax.tree.map(jax.numpy.zeros_like, params)
+            (loss, grads), _ = jax.lax.scan(body, (0.0, zero), (xs, ys))
+            loss = loss / grad_accum
+            grads = jax.tree.map(lambda g: g / grad_accum, grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def init_hybrid(model, optimizer, mesh: Mesh, seed: int = 0):
+    """Initialize (params, opt_state) already placed on the mesh."""
+    params = shard_params(model.init(seed), mesh, model.param_specs())
+    opt_state = jax.jit(optimizer.init)(params)
+    return params, opt_state

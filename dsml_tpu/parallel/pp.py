@@ -1,0 +1,96 @@
+"""Pipeline parallelism: microbatched stage schedule over the ``pp`` axis.
+
+The reference's literature corpus (GPipe, PipeDream, Chimera, Zero-Bubble —
+SURVEY.md §2.3 "PP: literature only") realized TPU-style: the layer stack is
+split into S stages, one per ``pp``-axis rank; activations hop stage→stage
+via ``ppermute`` (the chip-to-chip send the reference's BeginSend/StreamSend
+API *intended*, over ICI); M microbatches stream through a GPipe schedule of
+M+S-1 ticks, expressed as one ``lax.scan`` — so the whole pipelined forward
+is a single XLA program, and ``jax.grad`` through it yields the mirrored
+pipelined backward (synchronous GPipe semantics: bubble fraction
+(S-1)/(M+S-1), amortized by more microbatches).
+
+Params arrive layer-stacked (leading layer axis) and sharded ``P('pp', ...)``
+so shard_map hands each rank exactly its stage's layers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["pipeline_apply", "stack_layer_params", "pipeline_specs"]
+
+
+def stack_layer_params(layer_params: list) -> dict:
+    """[per-layer pytrees] → one pytree with a leading layer axis."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *layer_params)
+
+
+def pipeline_specs(layer_spec, axis: str = "pp"):
+    """PartitionSpec pytree for stacked layer params: layer axis → ``axis``."""
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.map(
+        lambda s: P(axis, *s), layer_spec, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def pipeline_apply(
+    layer_fn: Callable,
+    stage_params,
+    microbatches: jax.Array,
+    axis: str = "pp",
+) -> jax.Array:
+    """Run a layer stack as a pipeline. Call under ``shard_map``.
+
+    ``layer_fn(one_layer_params, x) -> x`` — one layer's transform (activation
+    shape preserved, the transformer-block invariant).
+    ``stage_params`` — this rank's layers, leading axis = layers-per-stage.
+    ``microbatches`` — [M, microbatch, ...], replicated across the axis
+    (only stage 0 consumes them).
+
+    Returns [M, microbatch, ...] outputs, replicated to every rank.
+    """
+    n_stage = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    n_micro = microbatches.shape[0]
+
+    def stage_fn(x):
+        def body(h, one_layer):
+            return layer_fn(one_layer, h), None
+
+        out, _ = lax.scan(body, x, stage_params)
+        return out
+
+    if n_stage == 1:
+        return jax.vmap(stage_fn)(microbatches)
+
+    ticks = n_micro + n_stage - 1
+    fwd_perm = [(i, i + 1) for i in range(n_stage - 1)]  # no wraparound; edge gets zeros
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # stage 0 ingests microbatch t; later stages consume what the
+        # previous stage handed over on the prior tick
+        feed = microbatches[jnp.clip(t, 0, n_micro - 1)]
+        x_in = jnp.where(rank == 0, feed, buf)
+        active = (t - rank >= 0) & (t - rank < n_micro)
+        y = jnp.where(active, stage_fn(x_in), jnp.zeros_like(x_in))
+        # last stage completes microbatch (t - n_stage + 1)
+        out_idx = t - (n_stage - 1)
+        write = (rank == n_stage - 1) & (out_idx >= 0)
+        slot = jnp.clip(out_idx, 0, n_micro - 1)
+        outputs = outputs.at[slot].set(jnp.where(write, y, outputs[slot]))
+        buf = lax.ppermute(y, axis, fwd_perm)
+        return (buf, outputs), None
+
+    buf0 = jnp.zeros_like(microbatches[0])
+    out0 = jnp.zeros_like(microbatches)
+    (_, outputs), _ = lax.scan(tick, (buf0, out0), jnp.arange(ticks))
+    # outputs are resident on the last stage only; replicate so every rank
+    # (e.g. a colocated loss/unembed) can proceed
+    return lax.psum(jnp.where(rank == n_stage - 1, outputs, 0.0), axis)

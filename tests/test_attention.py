@@ -1,0 +1,81 @@
+"""Sequence/context-parallel attention: ring and Ulysses must equal full
+attention exactly (SURVEY.md §5.7 — literature-only in the reference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dsml_tpu.ops.attention import attention, ring_attention, ulysses_attention
+
+B, H, S, D = 2, 8, 64, 16
+
+
+def _qkv(seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal((B, H, S, D)).astype(np.float32) for _ in range(3)]
+
+
+def _run_sp(mesh8, fn, q, k, v):
+    """Shard the sequence axis (2) over the 8-device ring and run fn."""
+    spec = P(None, None, "dev", None)
+    wrapped = jax.shard_map(fn, mesh=mesh8, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    return np.asarray(jax.jit(wrapped)(q, k, v))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_full(mesh8, causal):
+    q, k, v = _qkv()
+    expected = np.asarray(attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal))
+    got = _run_sp(mesh8, lambda q, k, v: ring_attention(q, k, v, "dev", causal), q, k, v)
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_full(mesh8, causal):
+    q, k, v = _qkv(1)
+    expected = np.asarray(attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal))
+    got = _run_sp(mesh8, lambda q, k, v: ulysses_attention(q, k, v, "dev", causal), q, k, v)
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_gradients_flow(mesh8):
+    """Training through the ring must differentiate cleanly (ppermute has a
+    transpose rule; the accumulators must not produce NaNs)."""
+    q, k, v = _qkv(2)
+
+    def loss_fn(q, k, v):
+        out = ring_attention(q, k, v, "dev", causal=True)
+        return jnp.sum(out**2)
+
+    def shard_loss(q, k, v):
+        return jax.lax.psum(loss_fn(q, k, v), "dev")
+
+    spec = P(None, None, "dev", None)
+    grads = jax.jit(
+        jax.grad(
+            lambda q, k, v: jax.shard_map(
+                shard_loss, mesh=mesh8, in_specs=(spec, spec, spec), out_specs=P(), check_vma=False
+            )(q, k, v)
+        )
+    )(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    assert np.isfinite(np.asarray(grads)).all()
+
+    # and the values must match grads of full attention
+    full_grads = jax.jit(
+        jax.grad(lambda q, k, v: jnp.sum(attention(q, k, v, True) ** 2))
+    )(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(full_grads), rtol=1e-3, atol=1e-4)
+
+
+def test_ulysses_requires_divisible_heads(mesh8):
+    q = jnp.zeros((1, 6, 64, 8))  # 6 heads % 8 devices != 0
+    spec = P(None, None, "dev", None)
+    with pytest.raises(ValueError):
+        jax.jit(
+            jax.shard_map(
+                lambda q: ulysses_attention(q, q, q, "dev"),
+                mesh=mesh8, in_specs=(spec,), out_specs=spec, check_vma=False,
+            )
+        )(q)

@@ -1,0 +1,188 @@
+"""GPT-2 flagship: single-device semantics and dp×sp×tp SPMD equivalence.
+
+The hybrid-parallel forward/loss must be numerically identical to the plain
+single-device model — TP psums, ring/Ulysses sequence parallelism, sharded-
+vocab cross-entropy, and MoE expert parallelism included.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+from dsml_tpu.parallel.hybrid import init_hybrid, make_hybrid_train_step
+from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+
+
+@pytest.fixture(scope="module")
+def hybrid_mesh(devices8):
+    return build_mesh(MeshSpec(dp=2, sp=2, tp=2), devices8)
+
+
+def _batch(cfg, batch=4, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, cfg.vocab_size, size=(batch, cfg.max_seq)).astype(np.int32)
+    return toks[:, :], np.roll(toks, -1, axis=1).astype(np.int32)
+
+
+def test_single_device_loss_near_uniform():
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(0)
+    x, y = _batch(cfg)
+    loss = float(jax.jit(model.loss)(params, x, y))
+    assert np.isfinite(loss)
+    assert abs(loss - np.log(cfg.vocab_size)) < 1.0  # fresh model ≈ uniform
+
+
+@pytest.mark.parametrize("attn_impl", ["ring", "ulysses"])
+def test_hybrid_loss_matches_single_device(hybrid_mesh, attn_impl):
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(1)
+    x, y = _batch(cfg, seed=2)
+    expected = float(jax.jit(model.loss)(params, x, y))
+
+    from dsml_tpu.parallel.hybrid import hybrid_loss_fn, shard_params
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    loss_fn = hybrid_loss_fn(model, attn_impl)
+    sharded = jax.jit(
+        jax.shard_map(
+            lambda p, x, y: lax.pmean(loss_fn(p, x, y), ("dp", "sp")),
+            mesh=hybrid_mesh,
+            in_specs=(model.param_specs(), P("dp", "sp"), P("dp", "sp")),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    placed = shard_params(params, hybrid_mesh, model.param_specs())
+    got = float(sharded(placed, x, y))
+    assert np.isclose(got, expected, rtol=5e-4), (got, expected)  # TP splits contractions -> f32 reorder noise
+
+
+def test_hybrid_train_step_converges(hybrid_mesh):
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    optimizer = optax.adam(1e-3)
+    step = make_hybrid_train_step(model, optimizer, hybrid_mesh)
+    params, opt_state = init_hybrid(model, optimizer, hybrid_mesh, seed=0)
+    x, y = _batch(cfg, batch=8, seed=3)
+    losses = []
+    for _ in range(10):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.5, losses  # memorizing one batch
+
+
+def test_grad_accumulation_matches_full_batch(hybrid_mesh):
+    """grad_accum=2 over the same samples must produce ~the same update as
+    one full-batch step (linearity of mean gradients)."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    optimizer = optax.sgd(0.1)
+    x, y = _batch(cfg, batch=8, seed=4)
+
+    outs = {}
+    for accum in (1, 2):
+        step = make_hybrid_train_step(model, optimizer, hybrid_mesh, grad_accum=accum)
+        params, opt_state = init_hybrid(model, optimizer, hybrid_mesh, seed=5)
+        params, _, loss = step(params, opt_state, x, y)
+        outs[accum] = (float(loss), jax.tree.leaves(params)[0])
+    assert np.isclose(outs[1][0], outs[2][0], rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(outs[1][1]), np.asarray(outs[2][1]), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_moe_spmd_matches_single_device(hybrid_mesh):
+    """Expert-parallel MoE (experts sharded over tp) must equal the
+    single-device MoE forward."""
+    cfg = GPT2Config.tiny(n_experts=4)
+    model = GPT2(cfg)
+    params = model.init(7)
+    x, y = _batch(cfg, seed=8)
+    expected = float(jax.jit(model.loss)(params, x, y))
+
+    from dsml_tpu.parallel.hybrid import hybrid_loss_fn, shard_params
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    sharded = jax.jit(
+        jax.shard_map(
+            lambda p, x, y: lax.pmean(hybrid_loss_fn(model)(p, x, y), ("dp", "sp")),
+            mesh=hybrid_mesh,
+            in_specs=(model.param_specs(), P("dp", "sp"), P("dp", "sp")),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    placed = shard_params(params, hybrid_mesh, model.param_specs())
+    got = float(sharded(placed, x, y))
+    assert np.isclose(got, expected, rtol=5e-4), (got, expected)
+
+
+def test_moe_training_converges(hybrid_mesh):
+    cfg = GPT2Config.tiny(n_experts=4)
+    model = GPT2(cfg)
+    optimizer = optax.adam(1e-3)
+    step = make_hybrid_train_step(model, optimizer, hybrid_mesh)
+    params, opt_state = init_hybrid(model, optimizer, hybrid_mesh, seed=0)
+    x, y = _batch(cfg, batch=8, seed=9)
+    first = last = None
+    for i in range(8):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        first = float(loss) if first is None else first
+        last = float(loss)
+    assert last < first - 0.3, (first, last)
+
+
+def test_tp_logits_match_single_device_exactly(devices8):
+    """Logit-level TP parity on a TP-only mesh: loss-only checks on a fresh
+    model sit at ~ln(vocab) under any weight permutation and once masked a
+    real q/k/v mis-sharding — compare the full logits instead."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from dsml_tpu.parallel.hybrid import shard_params
+
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(11)
+    x, _ = _batch(cfg, seed=12)
+    expected = np.asarray(jax.jit(model.apply)(params, x))
+
+    mesh = build_mesh(MeshSpec(tp=8), devices8)
+    sharded = jax.jit(
+        jax.shard_map(
+            lambda p, x: model.apply_spmd(p, x, tp_axis="tp", sp_axis="sp"),
+            mesh=mesh,
+            in_specs=(model.param_specs(), P("dp", "sp")),
+            out_specs=P("dp", "sp", "tp"),  # vocab-sharded logits reassemble
+            check_vma=False,
+        )
+    )
+    placed = shard_params(params, mesh, model.param_specs())
+    got = np.asarray(sharded(placed, x))
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=2e-4)
+
+
+def test_tp_requires_divisible_heads(devices8):
+    cfg = GPT2Config(vocab_size=512, max_seq=64, n_layer=1, n_head=6, d_model=48, d_ff=96)
+    model = GPT2(cfg)
+    from jax.sharding import PartitionSpec as P
+
+    mesh = build_mesh(MeshSpec(tp=8), devices8)
+    with pytest.raises(ValueError, match="n_head"):
+        jax.jit(
+            jax.shard_map(
+                lambda p, x: model.apply_spmd(p, x, tp_axis="tp"),
+                mesh=mesh,
+                in_specs=(model.param_specs(), P("dp", "sp")),
+                out_specs=P("dp", "sp", "tp"),
+                check_vma=False,
+            )
+        )(model.init(0), np.zeros((8, 64), np.int32))
