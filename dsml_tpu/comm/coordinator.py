@@ -52,6 +52,12 @@ class CoordinatorConfig(Config):
     dial_retries: int = cfg_field(3, help="CommInit dial attempts per device (reference: 3)")
     dial_backoff_s: float = cfg_field(0.5, help="sleep between dial attempts (reference: 500ms)")
     ring_algorithm: str = cfg_field("ring", help="AllReduceRing algorithm: ring|xla|naive")
+    elastic: bool = cfg_field(
+        False,
+        help="on device failure, re-rank the surviving devices and keep the "
+        "communicator alive instead of failing it permanently (the reference "
+        "marks it FAILED forever, SURVEY.md §5.3)",
+    )
 
 
 def _remote_error(info: "DeviceInfo", e: grpc.RpcError) -> DeviceError:
@@ -87,6 +93,11 @@ class CoordinatorRuntime:
 
     def __init__(self, config: CoordinatorConfig | None = None):
         self.config = config or CoordinatorConfig()
+        # Warm the native runtime now: its first use otherwise triggers a
+        # synchronous C++ build inside an RPC handler.
+        from dsml_tpu.runtime import native as _native
+
+        _native.available()
         self.comms: dict[int, Communicator] = {}
         self._next_comm = 1
         self._lock = threading.Lock()
@@ -294,6 +305,13 @@ class CoordinatorRuntime:
         mesh = self._comm_mesh(comm)
         if mesh is not None:
             return np.asarray(make_stacked_all_reduce(mesh, op, self.config.ring_algorithm)(stacked))
+        # cross-host fallback: reduce on the coordinator host — float32 goes
+        # through the native C++ kernel when built
+        if stacked.dtype == np.float32:
+            from dsml_tpu.runtime import native
+
+            reduced = native.reduce_f32(stacked.reshape(stacked.shape[0], -1), int(op))
+            return np.broadcast_to(reduced.reshape(stacked.shape[1:]), stacked.shape)
         combine = {
             ReduceOp.SUM: np.add.reduce,
             ReduceOp.AVG: lambda a: np.add.reduce(a) / a.shape[0],
@@ -426,13 +444,50 @@ class CoordinatorRuntime:
             except grpc.RpcError:
                 failed.append(info)
         if failed:
-            with comm.lock:
-                comm.devices = alive  # prune (reference :114)
-                comm.status = pb.FAILED
+            if self.config.elastic and alive:
+                # Elastic recovery: shrink the ring and keep going — the
+                # Varuna/Bamboo/Oobleck capability the reference shelved as
+                # literature (SURVEY.md §5.3). Survivors keep their relative
+                # order and get dense new ranks as FRESH DeviceInfo objects;
+                # the swap happens atomically under comm.lock so an in-flight
+                # collective sees either the old communicator (and fails on
+                # the dead device, as it must) or the recovered one — never a
+                # half-renumbered mix. NOTE: server-side recovery only —
+                # clients addressing per-rank memAddrs must re-resolve ranks
+                # (or re-CommInit) after a non-tail failure.
+                survivors = [
+                    dataclasses.replace(info, rank=new_rank)
+                    for new_rank, info in enumerate(alive)
+                ]
+                peer_map = {info.rank: info.address for info in survivors}
+                for info in survivors:
+                    try:
+                        info.stub.ConfigurePeers(
+                            pb.ConfigurePeersRequest(peerAddresses=peer_map, selfRank=info.rank),
+                            timeout=self.config.probe_timeout_s,
+                        )
+                    except grpc.RpcError as e:
+                        log.warning(
+                            "health: comm %d survivor %s did not take the new peer "
+                            "table (%s); its P2P routes may be stale until the next "
+                            "recovery pass", comm.comm_id, info.address, e,
+                        )
+                with comm.lock:
+                    comm.devices = survivors
+                    comm.status = pb.IN_PROGRESS  # clear any racing FAILED mark
+                log.warning(
+                    "health: comm %d lost %d device(s); recovered with %d survivors",
+                    comm.comm_id, len(failed), len(alive),
+                )
+            else:
+                with comm.lock:
+                    comm.devices = alive  # prune (reference :114)
+                    comm.status = pb.FAILED
+                for info in failed:
+                    log.warning("health: device %d (%s) unreachable; comm %d FAILED",
+                                info.device_id, info.address, comm.comm_id)
             for info in failed:
                 info.channel.close()  # pruned entries would otherwise leak channels
-                log.warning("health: device %d (%s) unreachable; comm %d FAILED",
-                            info.device_id, info.address, comm.comm_id)
 
 
 # ---------------------------------------------------------------------------

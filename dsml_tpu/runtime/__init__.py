@@ -1,0 +1,10 @@
+"""Native (C++) host runtime bindings."""
+
+from dsml_tpu.runtime.native import (  # noqa: F401
+    NativeArena,
+    NativeStreams,
+    available,
+    idx_parse,
+    reduce_f32,
+    ring_plan,
+)

@@ -1,0 +1,189 @@
+"""ctypes bindings for libdsml_runtime.so (see native/dsml_runtime.cc).
+
+The library auto-builds on first import when a compiler is present
+(``make -C dsml_tpu/runtime/native``); every consumer has a pure-Python/numpy
+fallback, so :func:`available` gates usage rather than imports failing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from dsml_tpu.utils.logging import get_logger
+
+log = get_logger("native")
+
+_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SO = os.path.join(_DIR, "libdsml_runtime.so")
+_lib = None
+_lock = threading.Lock()
+
+DS_OK = 0
+DS_IN_PROGRESS = 5
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO):
+            try:
+                subprocess.run(
+                    ["make", "-C", _DIR], check=True, capture_output=True, timeout=120
+                )
+            except (subprocess.SubprocessError, FileNotFoundError) as e:
+                log.warning("native runtime build failed (%s); using Python fallbacks", e)
+                _lib = False
+                return False
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            log.warning("native runtime load failed (%s); using Python fallbacks", e)
+            _lib = False
+            return False
+        lib.ds_arena_new.restype = ctypes.c_void_p
+        lib.ds_arena_new.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+        lib.ds_arena_free.argtypes = [ctypes.c_void_p]
+        lib.ds_arena_write.restype = ctypes.c_int32
+        lib.ds_arena_write.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64]
+        lib.ds_arena_read.restype = ctypes.c_int64
+        lib.ds_arena_read.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64]
+        lib.ds_arena_logical_size.restype = ctypes.c_int64
+        lib.ds_arena_logical_size.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ds_streams_new.restype = ctypes.c_void_p
+        lib.ds_streams_free.argtypes = [ctypes.c_void_p]
+        lib.ds_stream_arm.restype = ctypes.c_int32
+        lib.ds_stream_arm.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]
+        lib.ds_stream_push.restype = ctypes.c_int32
+        lib.ds_stream_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int32]
+        lib.ds_stream_status.restype = ctypes.c_int32
+        lib.ds_stream_status.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ds_ring_plan.restype = ctypes.c_int32
+        lib.ds_ring_plan.argtypes = [ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p]
+        lib.ds_reduce_f32.restype = ctypes.c_int32
+        lib.ds_reduce_f32.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p]
+        lib.ds_idx_parse.restype = ctypes.c_int64
+        lib.ds_idx_parse.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    return bool(_load())
+
+
+class NativeArena:
+    """Bounds-checked flat-address host buffer registry (C++)."""
+
+    def __init__(self, min_addr: int, size: int):
+        lib = _load()
+        if not lib:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._ptr = lib.ds_arena_new(min_addr, size)
+
+    def write(self, addr: int, data: bytes) -> int:
+        return self._lib.ds_arena_write(self._ptr, addr, data, len(data))
+
+    def read(self, addr: int, n: int | None = None) -> bytes:
+        if n is None:
+            n = self._lib.ds_arena_read(self._ptr, addr, None, 0)
+            if n < 0:
+                raise KeyError(f"arena read failed: status {-n}")
+        out = ctypes.create_string_buffer(n)
+        rc = self._lib.ds_arena_read(self._ptr, addr, out, n)
+        if rc < 0:
+            raise KeyError(f"arena read failed: status {-rc}")
+        return out.raw[:rc]
+
+    def logical_size(self, addr: int) -> int:
+        n = self._lib.ds_arena_logical_size(self._ptr, addr)
+        if n < 0:
+            raise KeyError(f"no buffer at {addr:#x}")
+        return n
+
+    def __del__(self):
+        if getattr(self, "_ptr", None):
+            self._lib.ds_arena_free(self._ptr)
+            self._ptr = None
+
+
+class NativeStreams:
+    """Chunked-stream reassembly engine writing into a NativeArena."""
+
+    def __init__(self, arena: NativeArena):
+        self._lib = arena._lib
+        self._arena = arena
+        self._ptr = self._lib.ds_streams_new()
+
+    def arm(self, stream_id: int, recv_addr: int, expected: int) -> int:
+        return self._lib.ds_stream_arm(self._ptr, self._arena._ptr, stream_id, recv_addr, expected)
+
+    def push(self, stream_id: int, chunk: bytes, final: bool = False) -> int:
+        return self._lib.ds_stream_push(self._ptr, self._arena._ptr, stream_id, chunk, len(chunk), int(final))
+
+    def status(self, stream_id: int) -> int:
+        return self._lib.ds_stream_status(self._ptr, stream_id)
+
+    def __del__(self):
+        if getattr(self, "_ptr", None):
+            self._lib.ds_streams_free(self._ptr)
+            self._ptr = None
+
+
+def ring_plan(n: int, rank: int) -> tuple[np.ndarray, np.ndarray]:
+    """The 2(n-1)-step ring segment schedule for ``rank`` (C++ planner)."""
+    lib = _load()
+    steps = 2 * (n - 1)
+    send = np.zeros(steps, np.int32)
+    recv = np.zeros(steps, np.int32)
+    if lib:
+        rc = lib.ds_ring_plan(n, rank,
+                              send.ctypes.data_as(ctypes.c_void_p),
+                              recv.ctypes.data_as(ctypes.c_void_p))
+        if rc != DS_OK:
+            raise ValueError(f"ring_plan({n}, {rank}) failed: {rc}")
+        return send, recv
+    for step in range(n - 1):  # Python fallback
+        send[step] = (rank - step) % n
+        recv[step] = (rank - step - 1) % n
+        send[n - 1 + step] = (rank - step + 1) % n
+        recv[n - 1 + step] = (rank - step) % n
+    return send, recv
+
+
+def reduce_f32(rows: np.ndarray, op: int) -> np.ndarray:
+    """Reduce [n_rows, n] float32 rows elementwise with the C++ kernel
+    (numpy fallback when the library is unavailable)."""
+    rows = np.ascontiguousarray(rows, np.float32)
+    lib = _load()
+    if lib:
+        out = np.empty(rows.shape[1], np.float32)
+        rc = lib.ds_reduce_f32(rows.ctypes.data_as(ctypes.c_void_p), rows.shape[0], rows.shape[1],
+                               int(op), out.ctypes.data_as(ctypes.c_void_p))
+        if rc == DS_OK:
+            return out
+    combine = {0: np.add.reduce, 1: np.multiply.reduce, 2: np.minimum.reduce,
+               3: np.maximum.reduce, 4: lambda a: np.add.reduce(a) / a.shape[0]}[int(op)]
+    return combine(rows).astype(np.float32)
+
+
+def idx_parse(blob: bytes) -> tuple[np.ndarray, tuple[int, ...]]:
+    """Parse an un-gzipped IDX blob via the C++ parser; returns
+    (uint8 payload array, dims)."""
+    lib = _load()
+    if lib:
+        dims = np.zeros(3, np.int32)
+        off = lib.ds_idx_parse(blob, len(blob), dims.ctypes.data_as(ctypes.c_void_p))
+        if off < 0:
+            raise ValueError(f"invalid IDX blob: status {-off}")
+        shape = tuple(int(d) for d in dims if d > 0)
+        data = np.frombuffer(blob, np.uint8, count=int(np.prod(shape)), offset=int(off))
+        return data.reshape(shape), shape
+    raise RuntimeError("native runtime unavailable")

@@ -1,0 +1,264 @@
+// dsml native host runtime.
+//
+// The compiled-language systems layer of the framework (the reference's
+// equivalent layer is Go: device memory map + stream state machine in
+// DSML/gpu_device_service/gpu_device_server.go, byte-wise reduction in
+// DSML/gpu_coordinator_service/gpu_coordinator_server.go:540-543,681-686,
+// ring schedule in :379-419, IDX parsing in DSML/client/client.go:270-350).
+// TPU compute stays in XLA; this library owns the host-side runtime pieces:
+//
+//   * arena        — bounds-checked flat-address buffer registry with the
+//                    framework's splice/logical-size semantics (host staging
+//                    for the gRPC data plane).
+//   * stream       — chunked P2P reassembly + length validation state machine.
+//   * ring planner — the 2(n-1)-step scatter-reduce/all-gather segment
+//                    schedule (send/recv indices per rank per step).
+//   * reduce       — dtype-aware elementwise reductions (SUM/PROD/MIN/MAX/AVG)
+//                    for the coordinator's cross-host fallback path.
+//   * idx parser   — IDX (MNIST) header/payload decoding.
+//
+// C ABI throughout; Python binds via ctypes (dsml_tpu/runtime/native.py).
+// Build: make -C dsml_tpu/runtime/native   ->  libdsml_runtime.so
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// error codes (mirror the gRPC codes the Python layer maps them to)
+// ---------------------------------------------------------------------------
+enum DsStatus : int32_t {
+  DS_OK = 0,
+  DS_OUT_OF_RANGE = 1,
+  DS_NOT_FOUND = 2,
+  DS_INVALID = 3,
+  DS_FAILED = 4,
+  DS_IN_PROGRESS = 5,
+};
+
+// ---------------------------------------------------------------------------
+// arena
+// ---------------------------------------------------------------------------
+
+struct DsBuffer {
+  std::vector<uint8_t> data;
+  uint64_t logical = 0;  // bytes of the most recent write
+};
+
+struct DsArena {
+  uint64_t min_addr;
+  uint64_t max_addr;
+  std::map<uint64_t, DsBuffer> buffers;
+  std::mutex mu;
+};
+
+void* ds_arena_new(uint64_t min_addr, uint64_t size) {
+  auto* a = new DsArena();
+  a->min_addr = min_addr;
+  a->max_addr = min_addr + size;
+  return a;
+}
+
+void ds_arena_free(void* arena) { delete static_cast<DsArena*>(arena); }
+
+int32_t ds_arena_write(void* arena, uint64_t addr, const uint8_t* data, uint64_t len) {
+  auto* a = static_cast<DsArena*>(arena);
+  // `addr + len` could wrap uint64 for a corrupt wire address; compare the
+  // remaining window instead
+  if (addr < a->min_addr || addr > a->max_addr || len > a->max_addr - addr)
+    return DS_OUT_OF_RANGE;
+  std::lock_guard<std::mutex> lock(a->mu);
+  DsBuffer& buf = a->buffers[addr];
+  if (buf.data.size() > len) {
+    // splice: shorter write lands in the prefix, tail survives
+    std::memcpy(buf.data.data(), data, len);
+  } else {
+    buf.data.assign(data, data + len);
+  }
+  buf.logical = len;
+  return DS_OK;
+}
+
+int64_t ds_arena_read(void* arena, uint64_t addr, uint8_t* out, uint64_t len) {
+  // returns bytes copied, or -status on error; len==0 => full buffer size query
+  auto* a = static_cast<DsArena*>(arena);
+  std::lock_guard<std::mutex> lock(a->mu);
+  auto it = a->buffers.find(addr);
+  if (it == a->buffers.end()) return -DS_NOT_FOUND;
+  const DsBuffer& buf = it->second;
+  if (len == 0) return static_cast<int64_t>(buf.data.size());
+  if (len > buf.data.size()) return -DS_OUT_OF_RANGE;
+  std::memcpy(out, buf.data.data(), len);
+  return static_cast<int64_t>(len);
+}
+
+int64_t ds_arena_logical_size(void* arena, uint64_t addr) {
+  auto* a = static_cast<DsArena*>(arena);
+  std::lock_guard<std::mutex> lock(a->mu);
+  auto it = a->buffers.find(addr);
+  if (it == a->buffers.end()) return -DS_NOT_FOUND;
+  return static_cast<int64_t>(it->second.logical);
+}
+
+// ---------------------------------------------------------------------------
+// stream reassembly
+// ---------------------------------------------------------------------------
+
+struct DsStream {
+  std::vector<uint8_t> chunks;
+  uint64_t expected = 0;
+  uint64_t recv_addr = 0;
+  bool armed = false;
+  int32_t status = DS_IN_PROGRESS;
+};
+
+struct DsStreamEngine {
+  std::map<uint64_t, DsStream> streams;
+  std::mutex mu;
+};
+
+void* ds_streams_new() { return new DsStreamEngine(); }
+void ds_streams_free(void* eng) { delete static_cast<DsStreamEngine*>(eng); }
+
+static void ds_stream_try_complete(DsArena* arena, DsStream& st) {
+  if (!st.armed) return;
+  if (st.chunks.size() == st.expected && st.expected > 0) {
+    int32_t rc = ds_arena_write(arena, st.recv_addr, st.chunks.data(), st.chunks.size());
+    st.status = (rc == DS_OK) ? DS_OK : DS_FAILED;
+    st.chunks.clear();
+    st.chunks.shrink_to_fit();
+  } else if (st.chunks.size() > st.expected) {
+    st.status = DS_FAILED;
+  }
+}
+
+int32_t ds_stream_arm(void* eng, void* arena, uint64_t stream_id, uint64_t recv_addr,
+                      uint64_t expected) {
+  auto* e = static_cast<DsStreamEngine*>(eng);
+  auto* a = static_cast<DsArena*>(arena);
+  if (recv_addr < a->min_addr || recv_addr > a->max_addr || expected > a->max_addr - recv_addr)
+    return DS_OUT_OF_RANGE;
+  std::lock_guard<std::mutex> lock(e->mu);
+  DsStream& st = e->streams[stream_id];
+  st.recv_addr = recv_addr;
+  st.expected = expected;
+  st.armed = true;
+  ds_stream_try_complete(a, st);
+  return DS_OK;
+}
+
+int32_t ds_stream_push(void* eng, void* arena, uint64_t stream_id, const uint8_t* chunk,
+                       uint64_t len, int32_t final_chunk) {
+  auto* e = static_cast<DsStreamEngine*>(eng);
+  auto* a = static_cast<DsArena*>(arena);
+  std::lock_guard<std::mutex> lock(e->mu);
+  DsStream& st = e->streams[stream_id];
+  st.chunks.insert(st.chunks.end(), chunk, chunk + len);
+  ds_stream_try_complete(a, st);
+  if (final_chunk && st.armed && st.status == DS_IN_PROGRESS) st.status = DS_FAILED;
+  return st.status == DS_FAILED ? DS_FAILED : DS_OK;
+}
+
+int32_t ds_stream_status(void* eng, uint64_t stream_id) {
+  auto* e = static_cast<DsStreamEngine*>(eng);
+  std::lock_guard<std::mutex> lock(e->mu);
+  auto it = e->streams.find(stream_id);
+  if (it == e->streams.end()) return -DS_NOT_FOUND;
+  return it->second.status;
+}
+
+// ---------------------------------------------------------------------------
+// ring schedule planner (gpu_coordinator_server.go:393-419 semantics)
+// ---------------------------------------------------------------------------
+
+// Fills send_idx/recv_idx, each [2*(n-1)] entries for `rank`: first n-1
+// scatter-reduce steps, then n-1 all-gather steps.
+int32_t ds_ring_plan(int32_t n, int32_t rank, int32_t* send_idx, int32_t* recv_idx) {
+  if (n < 2 || rank < 0 || rank >= n) return DS_INVALID;
+  auto mod = [n](int32_t v) { return ((v % n) + n) % n; };
+  for (int32_t step = 0; step < n - 1; ++step) {
+    send_idx[step] = mod(rank - step);
+    recv_idx[step] = mod(rank - step - 1);
+  }
+  for (int32_t step = 0; step < n - 1; ++step) {
+    send_idx[n - 1 + step] = mod(rank - step + 1);
+    recv_idx[n - 1 + step] = mod(rank - step);
+  }
+  return DS_OK;
+}
+
+// ---------------------------------------------------------------------------
+// dtype-aware reduction (coordinator host fallback path)
+// ---------------------------------------------------------------------------
+
+enum DsOp : int32_t { DS_SUM = 0, DS_PROD = 1, DS_MIN = 2, DS_MAX = 3, DS_AVG = 4 };
+
+// rows: n_rows contiguous f32 rows of n elems each; out: n elems
+int32_t ds_reduce_f32(const float* rows, int64_t n_rows, int64_t n, int32_t op, float* out) {
+  if (n_rows < 1) return DS_INVALID;
+  std::memcpy(out, rows, n * sizeof(float));
+  for (int64_t r = 1; r < n_rows; ++r) {
+    const float* row = rows + r * n;
+    switch (op) {
+      case DS_SUM:
+      case DS_AVG:
+        for (int64_t i = 0; i < n; ++i) out[i] += row[i];
+        break;
+      case DS_PROD:
+        for (int64_t i = 0; i < n; ++i) out[i] *= row[i];
+        break;
+      case DS_MIN:
+        for (int64_t i = 0; i < n; ++i) out[i] = row[i] < out[i] ? row[i] : out[i];
+        break;
+      case DS_MAX:
+        for (int64_t i = 0; i < n; ++i) out[i] = row[i] > out[i] ? row[i] : out[i];
+        break;
+      default:
+        return DS_INVALID;
+    }
+  }
+  if (op == DS_AVG) {
+    const float inv = 1.0f / static_cast<float>(n_rows);
+    for (int64_t i = 0; i < n; ++i) out[i] *= inv;
+  }
+  return DS_OK;
+}
+
+// ---------------------------------------------------------------------------
+// IDX (MNIST) parsing
+// ---------------------------------------------------------------------------
+
+static uint32_t be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) | (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+// Parses an (un-gzipped) IDX blob. dims_out must hold 3 entries:
+// images -> [count, rows, cols]; labels -> [count, 0, 0].
+// Returns the payload byte offset, or -status.
+int64_t ds_idx_parse(const uint8_t* buf, uint64_t len, int32_t* dims_out) {
+  if (len < 8) return -DS_INVALID;
+  uint32_t magic = be32(buf);
+  if (magic == 2051) {  // images
+    if (len < 16) return -DS_INVALID;
+    dims_out[0] = static_cast<int32_t>(be32(buf + 4));
+    dims_out[1] = static_cast<int32_t>(be32(buf + 8));
+    dims_out[2] = static_cast<int32_t>(be32(buf + 12));
+    uint64_t need = 16ull + uint64_t(dims_out[0]) * dims_out[1] * dims_out[2];
+    if (len < need) return -DS_INVALID;
+    return 16;
+  }
+  if (magic == 2049) {  // labels
+    dims_out[0] = static_cast<int32_t>(be32(buf + 4));
+    dims_out[1] = 0;
+    dims_out[2] = 0;
+    if (len < 8ull + uint64_t(dims_out[0])) return -DS_INVALID;
+    return 8;
+  }
+  return -DS_INVALID;
+}
+
+}  // extern "C"

@@ -39,6 +39,9 @@ class TrainConfig(Config):
     dp: int = field(0, help="data-parallel devices (0 = all local)")
     seed: int = field(0, help="init + shuffle seed")
     log_metrics: str = field("", help="optional JSONL metrics path")
+    checkpoint_dir: str = field("", help="Orbax checkpoint directory ('' = no checkpointing)")
+    save_every: int = field(1, help="checkpoint every N epochs")
+    resume: bool = field(False, help="resume from the latest checkpoint in checkpoint_dir")
 
 
 def _make_optimizer(cfg: TrainConfig, steps_per_epoch: int) -> optax.GradientTransformation:
@@ -97,9 +100,22 @@ class Trainer:
             params = jax.tree.map(lambda a: jax.numpy.array(a), params)
         opt_state = optimizer.init(params)
 
+        ckpt = None
+        start_epoch = 1
+        if cfg.checkpoint_dir:
+            from dsml_tpu.utils.checkpoint import Checkpointer
+
+            ckpt = Checkpointer(cfg.checkpoint_dir)
+            if cfg.resume and ckpt.latest_step() is not None:
+                state = ckpt.restore(template={"params": params, "opt_state": opt_state,
+                                               "meta": {"epoch": 0}})
+                params, opt_state = state["params"], state["opt_state"]
+                start_epoch = int(state["meta"]["epoch"]) + 1
+                log.info("resumed from checkpoint at epoch %d", start_epoch - 1)
+
         history = []
         t0 = time.monotonic()
-        for epoch in range(1, cfg.epochs + 1):
+        for epoch in range(start_epoch, cfg.epochs + 1):
             losses = []  # device arrays; synced only every sync_every steps so
             # dispatch of step k+1 overlaps execution of step k without the
             # in-flight queue growing unboundedly
@@ -118,9 +134,14 @@ class Trainer:
             history.append(
                 self.metrics.log(epoch=epoch, avg_loss=em.avg_loss, train_accuracy=train_acc)
             )
+            if ckpt is not None and epoch % max(cfg.save_every, 1) == 0:
+                ckpt.save(epoch, params, opt_state, meta={"epoch": epoch})
+        if ckpt is not None:
+            ckpt.close()
         test_acc = self.evaluate(params, data.test_x, data.test_y)
         wall = time.monotonic() - t0
-        samples = cfg.epochs * steps_per_epoch * cfg.batch_size
+        epochs_run = cfg.epochs - start_epoch + 1  # resume skips earlier epochs
+        samples = epochs_run * steps_per_epoch * cfg.batch_size
         log.info("Final Test Accuracy: %.2f%%", test_acc * 100)  # client.go:500-501 shape
         self.metrics.log(
             test_accuracy=test_acc, wall_time_s=wall, samples_per_sec=samples / max(wall, 1e-9)

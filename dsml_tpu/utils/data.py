@@ -31,17 +31,27 @@ _LABELS_MAGIC = 2049
 
 
 def _read_idx(path: str) -> np.ndarray:
-    """Parse one (gzipped) IDX file (images or labels)."""
+    """Parse one (gzipped) IDX file (images or labels). Decoding goes through
+    the native C++ runtime when built (dsml_tpu/runtime/native), with a pure
+    numpy fallback."""
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
-        magic, count = struct.unpack(">II", f.read(8))
-        if magic == _IMAGES_MAGIC:
-            rows, cols = struct.unpack(">II", f.read(8))
-            data = np.frombuffer(f.read(count * rows * cols), dtype=np.uint8)
-            return data.reshape(count, rows, cols)
-        if magic == _LABELS_MAGIC:
-            return np.frombuffer(f.read(count), dtype=np.uint8)
-        raise ValueError(f"{path}: unknown IDX magic {magic}")
+        blob = f.read()
+    try:
+        from dsml_tpu.runtime import native
+
+        if native.available():
+            data, _ = native.idx_parse(blob)
+            return data
+    except Exception as e:  # noqa: BLE001 — any native hiccup falls back
+        log.warning("native IDX parse failed (%s); numpy fallback", e)
+    magic, count = struct.unpack(">II", blob[:8])
+    if magic == _IMAGES_MAGIC:
+        rows, cols = struct.unpack(">II", blob[8:16])
+        return np.frombuffer(blob, np.uint8, count * rows * cols, 16).reshape(count, rows, cols)
+    if magic == _LABELS_MAGIC:
+        return np.frombuffer(blob, np.uint8, count, 8)
+    raise ValueError(f"{path}: unknown IDX magic {magic}")
 
 
 @dataclass

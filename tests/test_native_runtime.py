@@ -1,0 +1,90 @@
+"""Native C++ host runtime: arena, streams, ring planner, reduce, IDX.
+
+The library builds from source on first use (g++ via Makefile); these tests
+fail loudly if the toolchain is present but the build breaks, and skip only
+when no compiler exists.
+"""
+
+import gzip
+import shutil
+
+import numpy as np
+import pytest
+
+from dsml_tpu.runtime import native
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None and not native.available(),
+    reason="no C++ toolchain and no prebuilt library",
+)
+
+
+def test_native_builds_and_loads():
+    assert native.available(), "libdsml_runtime.so failed to build/load"
+
+
+def test_arena_bounds_splice_logical():
+    a = native.NativeArena(0x1000, 0x1000)
+    assert a.write(0x1000, bytes(range(16))) == 0
+    assert a.write(0x0F00, b"x") != 0  # below min_addr
+    assert a.write(0x1FFE, b"xxxx") != 0  # crosses max
+    assert a.write(0xFFFFFFFFFFFFFFF8, b"0123456789abcdef") != 0  # addr+len wraps uint64
+    # splice semantics: short write into the prefix, tail survives
+    assert a.write(0x1000, b"\xff\xff") == 0
+    assert a.read(0x1000, 16) == b"\xff\xff" + bytes(range(2, 16))
+    assert a.logical_size(0x1000) == 2
+    with pytest.raises(KeyError):
+        a.read(0x2000 - 8, 4)
+
+
+def test_stream_reassembly_and_out_of_order_arm():
+    a = native.NativeArena(0x1000, 0x1000)
+    s = native.NativeStreams(a)
+    # chunks before arm are buffered; completion on arm
+    s.push(7, b"chunk1")
+    s.push(7, b"chunk2")
+    assert s.status(7) == native.DS_IN_PROGRESS
+    s.arm(7, 0x1100, expected=12)
+    assert s.status(7) == native.DS_OK
+    assert a.read(0x1100, 12) == b"chunk1chunk2"
+    # wrong length fails
+    s.arm(9, 0x1200, expected=100)
+    s.push(9, b"short", final=True)
+    assert s.status(9) == 4  # DS_FAILED
+
+
+def test_ring_plan_matches_reference_schedule():
+    """send (rank-step) mod n / recv (rank-step-1) mod n, then the gather
+    phase (gpu_coordinator_server.go:393-404)."""
+    n = 4
+    for rank in range(n):
+        send, recv = native.ring_plan(n, rank)
+        for step in range(n - 1):
+            assert send[step] == (rank - step) % n
+            assert recv[step] == (rank - step - 1) % n
+            assert send[n - 1 + step] == (rank - step + 1) % n
+            assert recv[n - 1 + step] == (rank - step) % n
+
+
+@pytest.mark.parametrize("op,ref", [(0, np.sum), (1, np.prod), (2, np.min), (3, np.max)])
+def test_reduce_f32_matches_numpy(op, ref):
+    rows = (np.random.default_rng(0).random((6, 1000)) * 0.5 + 0.75).astype(np.float32)
+    out = native.reduce_f32(rows, op)
+    np.testing.assert_allclose(out, ref(rows, axis=0), rtol=1e-5)
+
+
+def test_idx_parse_real_mnist():
+    with gzip.open("data/mnist/t10k-labels-idx1-ubyte.gz", "rb") as f:
+        blob = f.read()
+    data, shape = native.idx_parse(blob)
+    assert shape == (10000,)
+    assert set(np.unique(data)) <= set(range(10))
+    with gzip.open("data/mnist/t10k-images-idx3-ubyte.gz", "rb") as f:
+        blob = f.read()
+    data, shape = native.idx_parse(blob)
+    assert shape == (10000, 28, 28)
+
+
+def test_idx_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        native.idx_parse(b"\x00\x00\x00\x07not idx data")
