@@ -448,13 +448,23 @@ class CoordinatorRuntime:
                 # Elastic recovery: shrink the ring and keep going — the
                 # Varuna/Bamboo/Oobleck capability the reference shelved as
                 # literature (SURVEY.md §5.3). Survivors keep their relative
-                # order and get dense new ranks as FRESH DeviceInfo objects;
-                # the swap happens atomically under comm.lock so an in-flight
-                # collective sees either the old communicator (and fails on
-                # the dead device, as it must) or the recovered one — never a
-                # half-renumbered mix. NOTE: server-side recovery only —
-                # clients addressing per-rank memAddrs must re-resolve ranks
-                # (or re-CommInit) after a non-tail failure.
+                # order and get dense new ranks as FRESH DeviceInfo objects.
+                # Order matters: (1) fail the comm so no NEW collective
+                # starts, (2) drain in-flight collectives (they run against
+                # the OLD rank tables and must fail on the dead device, not
+                # get misrouted to a renumbered survivor), (3) only then push
+                # the new peer tables device-side and swap coordinator state.
+                # NOTE: server-side recovery only — clients addressing
+                # per-rank memAddrs must re-resolve ranks (or re-CommInit)
+                # after a non-tail failure.
+                with comm.lock:
+                    comm.status = pb.FAILED
+                deadline = time.monotonic() + self.config.probe_timeout_s
+                while time.monotonic() < deadline:
+                    with comm.lock:
+                        if comm.in_flight == 0:
+                            break
+                    time.sleep(0.01)
                 survivors = [
                     dataclasses.replace(info, rank=new_rank)
                     for new_rank, info in enumerate(alive)
@@ -474,7 +484,7 @@ class CoordinatorRuntime:
                         )
                 with comm.lock:
                     comm.devices = survivors
-                    comm.status = pb.IN_PROGRESS  # clear any racing FAILED mark
+                    comm.status = pb.IN_PROGRESS  # recovered; accept collectives again
                 log.warning(
                     "health: comm %d lost %d device(s); recovered with %d survivors",
                     comm.comm_id, len(failed), len(alive),

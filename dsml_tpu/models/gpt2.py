@@ -243,10 +243,18 @@ class GPT2:
             return t.reshape(b, s, n_head_local, -1).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        if sp_axis and attn_impl == "ring":
-            out = ring_attention(q, k, v, sp_axis, causal=True)
-        elif sp_axis and attn_impl == "ulysses":
-            out = ulysses_attention(q, k, v, sp_axis, causal=True)
+        if sp_axis:
+            # sequence is sharded: only ring/Ulysses see the full context.
+            # Anything else (incl. "flash", a single-chip kernel) would be
+            # silently-wrong block-diagonal attention — route it to ring.
+            if attn_impl == "ulysses":
+                out = ulysses_attention(q, k, v, sp_axis, causal=True)
+            else:
+                out = ring_attention(q, k, v, sp_axis, causal=True)
+        elif attn_impl == "flash":
+            from dsml_tpu.ops.flash import flash_attention
+
+            out = flash_attention(q, k, v, causal=True)
         else:
             out = attention(q, k, v, causal=True)
         b, _, s, _ = out.shape

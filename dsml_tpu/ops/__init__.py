@@ -9,3 +9,4 @@ from dsml_tpu.ops.collectives import (  # noqa: F401
     reduce_scatter,
     ring_all_reduce,
 )
+from dsml_tpu.ops.flash import flash_attention  # noqa: F401

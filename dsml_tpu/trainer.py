@@ -136,11 +136,17 @@ class Trainer:
             )
             if ckpt is not None and epoch % max(cfg.save_every, 1) == 0:
                 ckpt.save(epoch, params, opt_state, meta={"epoch": epoch})
+        last_epoch = cfg.epochs
         if ckpt is not None:
+            # final state must always be persisted, even when epochs isn't a
+            # multiple of save_every (otherwise the reported model is lost and
+            # resume would redo the last epochs)
+            if last_epoch >= start_epoch and last_epoch % max(cfg.save_every, 1) != 0:
+                ckpt.save(last_epoch, params, opt_state, meta={"epoch": last_epoch})
             ckpt.close()
         test_acc = self.evaluate(params, data.test_x, data.test_y)
         wall = time.monotonic() - t0
-        epochs_run = cfg.epochs - start_epoch + 1  # resume skips earlier epochs
+        epochs_run = max(cfg.epochs - start_epoch + 1, 0)  # resume skips earlier epochs
         samples = epochs_run * steps_per_epoch * cfg.batch_size
         log.info("Final Test Accuracy: %.2f%%", test_acc * 100)  # client.go:500-501 shape
         self.metrics.log(
