@@ -16,8 +16,10 @@ grids). Both are realized here as first-class mesh programs:
 - :func:`ulysses_attention` — all-to-all re-shard: sequence-sharded →
   head-sharded before attention, back after (DeepSpeed-Ulysses / LoongTrain
   head-parallelism), for meshes where an all-to-all beats n-1 ring hops.
+- :func:`attention_2d` — LoongTrain's 2D grid: Ulysses all-to-all over the
+  inner (fast) axis × ring over the outer (slow) axis.
 
-All three agree numerically; tests assert it.
+All variants agree numerically; tests assert it.
 """
 
 from __future__ import annotations
@@ -28,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["attention", "ring_attention", "ulysses_attention"]
+__all__ = ["attention", "ring_attention", "ulysses_attention", "attention_2d"]
 
 _NEG_INF = -1e30
 
@@ -106,6 +108,19 @@ def ring_attention(
     return num / jnp.maximum(den, 1e-30)
 
 
+def _seq_to_heads(t, axis_name):  # [b, h, s/n, d] -> [b, h/n, s, d]
+    return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def _heads_to_seq(t, axis_name):  # [b, h/n, s, d] -> [b, h, s/n, d]
+    return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _check_head_split(q, n):
+    if q.shape[1] % n:
+        raise ValueError(f"heads ({q.shape[1]}) not divisible by axis size {n}")
+
+
 def ulysses_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str, causal: bool = True
 ) -> jax.Array:
@@ -119,14 +134,45 @@ def ulysses_attention(
     n = lax.axis_size(axis_name)
     if n == 1:
         return attention(q, k, v, causal)
-    if q.shape[1] % n:
-        raise ValueError(f"heads ({q.shape[1]}) not divisible by axis size {n}")
+    _check_head_split(q, n)
+    out = attention(
+        _seq_to_heads(q, axis_name), _seq_to_heads(k, axis_name), _seq_to_heads(v, axis_name), causal
+    )
+    return _heads_to_seq(out, axis_name)
 
-    def seq_to_heads(t):  # [b, h, s/n, d] -> [b, h/n, s, d]
-        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
-    def heads_to_seq(t):  # [b, h/n, s, d] -> [b, h, s/n, d]
-        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1, tiled=True)
+def attention_2d(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    inner_axis: str,
+    outer_axis: str,
+    causal: bool = True,
+) -> jax.Array:
+    """LoongTrain-style 2D attention: head-parallel inner × context-parallel
+    outer grid (SURVEY.md §5.7, ``Literatures/2.Sequence Parallelism/
+    2406.18485v1.pdf``).
 
-    out = attention(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal)
-    return heads_to_seq(out)
+    The sequence is sharded over BOTH axes, outer-major — under ``shard_map``
+    pass the sequence dim spec ``P((outer, inner))`` so rank (o, i) holds
+    global sub-block ``o·n_inner + i``. One all-to-all over the *inner* axis
+    (the fast interconnect — ICI intra-slice on TPU) re-shards heads and
+    leaves every inner rank holding its group's full contiguous outer block;
+    ring attention then walks K/V around the *outer* axis only (the slow
+    hops — DCN inter-slice), so the n−1-step ring is n_inner× shorter than a
+    flat ring over all devices. A second all-to-all restores the layout.
+
+    Requires ``heads % inner_axis_size == 0``; exact for any causal/full mask.
+    """
+    n_inner = lax.axis_size(inner_axis)
+    if n_inner == 1:
+        return ring_attention(q, k, v, outer_axis, causal)
+    _check_head_split(q, n_inner)
+    out = ring_attention(
+        _seq_to_heads(q, inner_axis),
+        _seq_to_heads(k, inner_axis),
+        _seq_to_heads(v, inner_axis),
+        outer_axis,
+        causal,
+    )
+    return _heads_to_seq(out, inner_axis)

@@ -69,6 +69,53 @@ def test_ring_attention_gradients_flow(mesh8):
     np.testing.assert_allclose(np.asarray(grads), np.asarray(full_grads), rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.parametrize("grid", [(4, 2), (2, 4)])
+@pytest.mark.parametrize("causal", [True, False])
+def test_attention_2d_matches_full(devices8, grid, causal):
+    """LoongTrain 2D: Ulysses over the inner axis × ring over the outer."""
+    from jax.sharding import Mesh
+
+    from dsml_tpu.ops.attention import attention_2d
+
+    n_outer, n_inner = grid
+    mesh = Mesh(np.asarray(devices8).reshape(n_outer, n_inner), ("o", "i"))
+    q, k, v = _qkv(3)
+    expected = np.asarray(attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal))
+    spec = P(None, None, ("o", "i"), None)  # sequence sharded outer-major over BOTH axes
+    wrapped = jax.shard_map(
+        lambda q, k, v: attention_2d(q, k, v, "i", "o", causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
+    )
+    got = np.asarray(jax.jit(wrapped)(q, k, v))
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
+
+
+def test_attention_2d_gradients_match(devices8):
+    from jax.sharding import Mesh
+
+    from dsml_tpu.ops.attention import attention_2d
+
+    mesh = Mesh(np.asarray(devices8).reshape(2, 4), ("o", "i"))
+    q, k, v = _qkv(4)
+    spec = P(None, None, ("o", "i"), None)
+
+    def shard_loss(q, k, v):
+        out = attention_2d(q, k, v, "i", "o", causal=True)
+        return jax.lax.psum(jnp.sum(out**2), ("o", "i"))
+
+    grads = jax.jit(
+        jax.grad(
+            lambda q, k, v: jax.shard_map(
+                shard_loss, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P(), check_vma=False
+            )(q, k, v)
+        )
+    )(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    full_grads = jax.jit(
+        jax.grad(lambda q, k, v: jnp.sum(attention(q, k, v, True) ** 2))
+    )(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(full_grads), rtol=1e-3, atol=1e-4)
+
+
 def test_ulysses_requires_divisible_heads(mesh8):
     q = jnp.zeros((1, 6, 64, 8))  # 6 heads % 8 devices != 0
     spec = P(None, None, "dev", None)
