@@ -46,43 +46,28 @@ def bench_ring_allreduce() -> dict:
     # (the "ring latency from real ICI" number BASELINE.json asks for).
     # Per-dispatch overhead (the axon tunnel RTT alone is tens of ms) would
     # swamp a sub-ms collective, so time R chained rings in ONE program for
-    # R=1 and R=20 and difference them.
-    import functools
+    # R=1 and R=20 and difference them. This is the SAME program the gRPC
+    # coordinator dispatches (collectives._stacked_all_reduce_fn), so the
+    # bench measures the production path.
+    from dsml_tpu.ops.collectives import _stacked_all_reduce_fn
 
-    from dsml_tpu.ops.collectives import ring_all_reduce
-
-    spec = P("dp")
-
-    def ring_repeat(r):
-        @functools.partial(
-            jax.jit,
-            in_shardings=NamedSharding(mesh, spec),
-            out_shardings=NamedSharding(mesh, spec),
-        )
-        @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
-        )
-        def fn(stacked):
-            x = stacked[0]
-            for _ in range(r):
-                x = ring_all_reduce(x, "dp")
-            return x[None]
-
-        return fn
-
-    x_dev = jax.device_put(payload, NamedSharding(mesh, spec))
-
-    def p50_of(fn):
-        fn(x_dev).block_until_ready()  # compile
+    def p50_of(r):
+        fn = _stacked_all_reduce_fn(mesh, "dp", ReduceOp.SUM, "ring", repeats=r)
+        # the jit donates its input; chain outputs (same sharding) instead of
+        # reusing one buffer. SUM over zeros stays zeros, so values are stable.
+        x = jax.device_put(payload, NamedSharding(mesh, P("dp")))
+        x = fn(x)
+        x.block_until_ready()  # compile + first run
         ts = []
         for _ in range(reps):
             t0 = time.monotonic()
-            fn(x_dev).block_until_ready()
+            x = fn(x)
+            x.block_until_ready()
             ts.append((time.monotonic() - t0) * 1e3)
         return float(np.percentile(ts, 50))
 
     r_hi = 20
-    t1, t20 = p50_of(ring_repeat(1)), p50_of(ring_repeat(r_hi))
+    t1, t20 = p50_of(1), p50_of(r_hi)
     p50 = max((t20 - t1) / (r_hi - 1), 0.0)
 
     # (b) the full proto-API path the gRPC coordinator pays: H2D + ring + D2H

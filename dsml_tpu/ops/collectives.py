@@ -252,9 +252,13 @@ def ppermute_ring(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
 
 
 @functools.lru_cache(maxsize=None)
-def _stacked_all_reduce_fn(mesh: Mesh, axis_name: str, op: ReduceOp, algorithm: str):
-    # Keyed per (mesh, axis, op, algorithm); jax.jit itself specializes per
-    # input shape/dtype and retains those executables.
+def _stacked_all_reduce_fn(
+    mesh: Mesh, axis_name: str, op: ReduceOp, algorithm: str, repeats: int = 1
+):
+    # Keyed per (mesh, axis, op, algorithm, repeats); jax.jit itself
+    # specializes per input shape/dtype and retains those executables.
+    # ``repeats`` chains the collective back-to-back inside ONE program —
+    # bench.py uses it to difference away per-dispatch overhead.
     spec = P(axis_name)
 
     @functools.partial(
@@ -267,7 +271,10 @@ def _stacked_all_reduce_fn(mesh: Mesh, axis_name: str, op: ReduceOp, algorithm: 
         jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
     )
     def fn(stacked):  # stacked: [1, ...] per-device shard
-        return all_reduce(stacked[0], axis_name, op, algorithm)[None]
+        x = stacked[0]
+        for _ in range(repeats):
+            x = all_reduce(x, axis_name, op, algorithm)
+        return x[None]
 
     return fn
 

@@ -47,6 +47,9 @@ def make_dp_train_step(
     """
     repl = NamedSharding(mesh, P())
     batch_sh = NamedSharding(mesh, P(axis))
+    # Loss-reactive transforms (adaptive_plateau) consume the loss via
+    # ``value=``; the wrapper lets every optimizer accept the extra arg.
+    optimizer = optax.with_extra_args_support(optimizer)
 
     if algorithm == "xla":
 
@@ -72,7 +75,7 @@ def make_dp_train_step(
 
     def step(params, opt_state, x, y):
         loss, grads = compute_grads(params, x, y)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
+        updates, opt_state = optimizer.update(grads, opt_state, params, value=loss)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 

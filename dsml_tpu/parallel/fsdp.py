@@ -48,10 +48,13 @@ def make_fsdp_train_step(
     ZeRO: every rank computes on its batch shard with gathered weights).
     XLA inserts the all-gather/reduce-scatter schedule from the shardings."""
     batch_sh = NamedSharding(mesh, P(batch_axes))
+    # value= lets loss-reactive transforms (utils.schedules.adaptive_plateau)
+    # see the loss; the wrapper makes every optimizer accept it
+    optimizer = optax.with_extra_args_support(optimizer)
 
     def step(params, opt_state, x, y):
         loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
+        updates, opt_state = optimizer.update(grads, opt_state, params, value=loss)
         return optax.apply_updates(params, updates), opt_state, loss
 
     jitted = jax.jit(step, donate_argnums=(0, 1))

@@ -60,6 +60,9 @@ def make_hybrid_train_step(
     pspecs = model.param_specs()
     batch_spec = P("dp", "sp")
     loss_fn = hybrid_loss_fn(model, attn_impl)
+    # value= lets loss-reactive transforms (utils.schedules.adaptive_plateau)
+    # see the loss; the wrapper makes every optimizer accept it
+    optimizer = optax.with_extra_args_support(optimizer)
 
     def grads_fn(params, x, y):
         loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
@@ -95,7 +98,7 @@ def make_hybrid_train_step(
             (loss, grads), _ = jax.lax.scan(body, (0.0, zero), (xs, ys))
             loss = loss / grad_accum
             grads = jax.tree.map(lambda g: g / grad_accum, grads)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
+        updates, opt_state = optimizer.update(grads, opt_state, params, value=loss)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 

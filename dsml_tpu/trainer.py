@@ -33,8 +33,10 @@ class TrainConfig(Config):
     batch_size: int = field(64, help="GLOBAL batch size (reference: 64)")
     lr: float = field(0.01, help="SGD learning rate (reference: 0.01)")
     optimizer: str = field("sgd", help="sgd | momentum | adam | adamw")
-    lr_schedule: str = field("constant", help="constant | cosine (the adaptive LR the reference README promised but never shipped, SURVEY.md §8.8)")
+    lr_schedule: str = field("constant", help="constant | cosine | linear | step | plateau (the adaptive LR the reference README promised but never shipped, SURVEY.md §8.8)")
     warmup_steps: int = field(0, help="linear warmup steps for the schedule")
+    plateau_patience: int = field(5, help="plateau schedule: epochs-worth of steps without improvement before decaying")
+    plateau_factor: float = field(0.5, help="plateau schedule: lr decay factor")
     algorithm: str = field("xla", help="gradient sync: xla | ring | naive")
     dp: int = field(0, help="data-parallel devices (0 = all local)")
     seed: int = field(0, help="init + shuffle seed")
@@ -45,24 +47,27 @@ class TrainConfig(Config):
 
 
 def _make_optimizer(cfg: TrainConfig, steps_per_epoch: int) -> optax.GradientTransformation:
-    if cfg.lr_schedule == "cosine":
-        total = max(cfg.epochs * steps_per_epoch, 1)
-        lr = optax.warmup_cosine_decay_schedule(
-            0.0, cfg.lr, max(cfg.warmup_steps, 1), total
-        )
-    elif cfg.warmup_steps > 0:
-        lr = optax.join_schedules(
-            [optax.linear_schedule(0.0, cfg.lr, cfg.warmup_steps), optax.constant_schedule(cfg.lr)],
-            [cfg.warmup_steps],
-        )
-    else:
-        lr = cfg.lr
-    return {
+    from dsml_tpu.utils.schedules import make_schedule, wrap_with_plateau
+
+    total = max(cfg.epochs * steps_per_epoch, 1)
+    lr = make_schedule(cfg.lr_schedule, cfg.lr, total, cfg.warmup_steps)
+    opt = {
         "sgd": lambda: optax.sgd(lr),
         "momentum": lambda: optax.sgd(lr, momentum=0.9),
         "adam": lambda: optax.adam(lr),
         "adamw": lambda: optax.adamw(lr, weight_decay=1e-4),
     }[cfg.optimizer]()
+    if cfg.lr_schedule == "plateau":
+        # the reference-documented "adaptive learning rate scheduler":
+        # monitor the per-step loss, decay when it stops improving
+        # one accumulated loss evaluation per epoch; patience counts epochs
+        opt = wrap_with_plateau(
+            opt,
+            factor=cfg.plateau_factor,
+            patience=cfg.plateau_patience,
+            accumulation_size=max(steps_per_epoch, 1),
+        )
+    return opt
 
 
 class Trainer:

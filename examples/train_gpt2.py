@@ -1,0 +1,150 @@
+"""GPT-2 (up to -small/125M) hybrid-parallel language-model training —
+BASELINE.json config #5 ("TinyStories GPT-2-small, data-parallel AllReduce +
+grad accumulation").
+
+One jitted step over a dp×sp×tp mesh: Megatron tensor parallelism, ring (or
+Ulysses) sequence-parallel attention, data-parallel batch sharding with
+on-device gradient accumulation — the full hybrid-parallelism roadmap the
+reference carried only as literature (SURVEY.md §2.3).
+
+Token source: ``--data`` can point at any UTF-8 text file (e.g. a
+TinyStories dump). Without one (this container has no egress), a
+procedurally generated story corpus is byte-tokenized so the loss measures
+real sequence structure, not noise.
+
+    python examples/train_gpt2.py --steps 20 --platform cpu --cpu_devices 8 \
+        --model tiny --dp 2 --sp 2 --tp 2
+    python examples/train_gpt2.py --steps 200 --model small --grad_accum 4
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")  # repo-root invocation
+
+from dsml_tpu.utils.config import Config, field
+
+
+@dataclasses.dataclass
+class GPT2TrainConfig(Config):
+    platform: str = field("", help="jax platform override: cpu|tpu ('' = default)")
+    cpu_devices: int = field(0, help="virtual CPU device count for --platform cpu")
+    model: str = field("tiny", help="tiny | small (125M, the BASELINE config)")
+    data: str = field("", help="UTF-8 text file to train on ('' = generated stories)")
+    steps: int = field(50, help="optimizer steps")
+    batch_size: int = field(8, help="GLOBAL batch size (rows per optimizer step)")
+    seq_len: int = field(0, help="sequence length (0 = model max)")
+    grad_accum: int = field(2, help="gradient-accumulation microbatches per step")
+    dp: int = field(0, help="data-parallel size (0 = derive from devices)")
+    sp: int = field(1, help="sequence-parallel size")
+    tp: int = field(1, help="tensor-parallel size")
+    attn: str = field("ring", help="sequence-parallel attention: ring | ulysses")
+    lr: float = field(3e-4, help="peak learning rate")
+    warmup_steps: int = field(10, help="linear warmup steps")
+    seed: int = field(0, help="init/data seed")
+    log_every: int = field(10, help="log every N steps")
+
+
+_WORDS = {
+    "subj": ["the cat", "a dog", "the girl", "a boy", "the robot", "her friend"],
+    "verb": ["found", "chased", "painted", "built", "lost", "shared"],
+    "obj": ["a ball", "the kite", "a tiny boat", "the red box", "a shiny coin"],
+    "end": ["and smiled.", "and ran home.", "by the river.", "under the tree."],
+}
+
+
+def _generated_stories(n_chars: int, seed: int) -> bytes:
+    """TinyStories-shaped filler: simple grammatical sentences, so next-byte
+    prediction has learnable structure (articles, spaces, word stems)."""
+    rng = np.random.default_rng(seed)
+    parts = []
+    size = 0
+    while size < n_chars:
+        s = (
+            f"{rng.choice(_WORDS['subj'])} {rng.choice(_WORDS['verb'])} "
+            f"{rng.choice(_WORDS['obj'])} {rng.choice(_WORDS['end'])} "
+        )
+        parts.append(s)
+        size += len(s)
+    return "".join(parts).encode()
+
+
+def main(argv=None):
+    cfg = GPT2TrainConfig.parse_args(argv)
+    from dsml_tpu.utils.platform import configure_platform
+
+    configure_platform(cfg.platform, cfg.cpu_devices)
+
+    import jax
+    import optax
+
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.parallel.hybrid import init_hybrid, make_hybrid_train_step
+    from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+    from dsml_tpu.utils.logging import get_logger
+    from dsml_tpu.utils.schedules import make_schedule
+
+    log = get_logger("gpt2")
+    devices = jax.devices()
+    dp = cfg.dp or max(len(devices) // (cfg.sp * cfg.tp), 1)
+    mesh = build_mesh(MeshSpec(dp=dp, sp=cfg.sp, tp=cfg.tp), devices[: dp * cfg.sp * cfg.tp])
+
+    model_cfg = GPT2Config.small() if cfg.model == "small" else GPT2Config.tiny(vocab_size=256)
+    if cfg.model == "tiny":
+        model_cfg = dataclasses.replace(model_cfg, vocab_size=256)  # byte tokens
+    model = GPT2(model_cfg)
+    seq = cfg.seq_len or model_cfg.max_seq
+
+    # ---- tokens: file or generated corpus, byte-level --------------------------
+    if cfg.data and os.path.exists(cfg.data):
+        with open(cfg.data, "rb") as f:
+            corpus = f.read()
+        log.info("training on %s (%d bytes)", cfg.data, len(corpus))
+    else:
+        need = cfg.steps * cfg.batch_size * (seq + 1) * 2
+        corpus = _generated_stories(max(need, 1 << 20), cfg.seed)
+        log.info("no --data file; generated %d bytes of story corpus", len(corpus))
+    tokens = np.frombuffer(corpus, np.uint8).astype(np.int32) % model_cfg.vocab_size
+
+    def sample_batch(rng):
+        starts = rng.integers(0, len(tokens) - seq - 1, size=cfg.batch_size)
+        x = np.stack([tokens[s : s + seq] for s in starts])
+        y = np.stack([tokens[s + 1 : s + seq + 1] for s in starts])
+        return x, y
+
+    optimizer = optax.adamw(make_schedule("cosine", cfg.lr, cfg.steps, cfg.warmup_steps))
+    step = make_hybrid_train_step(
+        model, optimizer, mesh, attn_impl=cfg.attn, grad_accum=cfg.grad_accum
+    )
+    params, opt_state = init_hybrid(model, optimizer, mesh, seed=cfg.seed)
+    n_params = model.n_params(params)
+    log.info(
+        "GPT-2 %s: %.1fM params, mesh dp=%d sp=%d tp=%d, seq=%d, batch=%d x accum=%d",
+        cfg.model, n_params / 1e6, dp, cfg.sp, cfg.tp, seq, cfg.batch_size, cfg.grad_accum,
+    )
+
+    rng = np.random.default_rng(cfg.seed)
+    t0 = time.monotonic()
+    tokens_done = 0
+    first_loss = None
+    for i in range(1, cfg.steps + 1):
+        x, y = sample_batch(rng)
+        params, opt_state, loss = step(params, opt_state, x, y)
+        tokens_done += x.size
+        if first_loss is None:
+            first_loss = float(loss)
+        if i % cfg.log_every == 0 or i == cfg.steps:
+            loss_f = float(loss)
+            tps = tokens_done / max(time.monotonic() - t0, 1e-9)
+            log.info("step %d: loss = %.4f, %.0f tokens/s", i, loss_f, tps)
+    return {"first_loss": first_loss, "last_loss": float(loss)}
+
+
+if __name__ == "__main__":
+    main()
