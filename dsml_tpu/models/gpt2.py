@@ -138,10 +138,12 @@ class GPT2:
 
     # ---- sharding rules (GSPMD specs over the framework mesh axes) -------------
 
-    def param_specs(self) -> dict:
+    def param_specs(self, pp: bool = False) -> dict:
         """PartitionSpec pytree: Megatron TP sharding over 'tp', everything
         else replicated (dp/sp replicate params; fsdp would further shard —
-        see parallel.fsdp)."""
+        see parallel.fsdp). With ``pp=True`` the layer list is expected
+        STACKED (leading layer axis, ``parallel.pp.stack_layer_params``) and
+        sharded over the 'pp' axis so each rank holds its pipeline stage."""
         from jax.sharding import PartitionSpec as P
 
         cfg = self.config
@@ -170,11 +172,17 @@ class GPT2:
                 "w_out": P("tp", None),
                 "b_out": P(),
             }
+        if pp:
+            from dsml_tpu.parallel.pp import pipeline_specs
+
+            layers_spec = pipeline_specs(layer_spec, "pp")
+        else:
+            layers_spec = [layer_spec for _ in range(cfg.n_layer)]
         return {
             "wte": P("tp", None),  # vocab-sharded embedding/unembedding
             "wpe": P(),
             "ln_f": {"scale": P(), "bias": P()},
-            "layers": [layer_spec for _ in range(cfg.n_layer)],
+            "layers": layers_spec,
         }
 
     # ---- forward (per-rank SPMD function; axis names optional) -----------------
@@ -187,12 +195,22 @@ class GPT2:
         sp_axis: str | None = None,
         attn_impl: str = "ring",
         seq_offset: int | None = None,
+        pp_axis: str | None = None,
+        n_micro: int = 1,
     ) -> jax.Array:
         """Per-rank forward to vocab-shard logits.
 
         Under shard_map: ``tokens`` is this rank's (batch, sequence) shard;
         weights arrive TP-sharded per :meth:`param_specs`. Returns logits
         sharded over tp on the vocab dim: [batch_shard, seq_shard, vocab/tp].
+
+        With ``pp_axis`` set, ``params['layers']`` must be the STACKED stage
+        shard (``param_specs(pp=True)``) and the block stack runs as a GPipe
+        pipeline of ``n_micro`` microbatches (``parallel.pp``): every rank
+        computes the embedding but only stage 0's result enters the pipeline
+        (so embedding gradients land on rank 0 alone), activations hop
+        stage→stage over ``ppermute``, and the returned logits are replicated
+        across pp ranks.
         """
         cfg = self.config
         tp_size = lax.axis_size(tp_axis) if tp_axis else 1
@@ -219,15 +237,36 @@ class GPT2:
             h = params["wte"][tokens]
         h = h + params["wpe"][pos]
 
-        for layer in params["layers"]:
-            h = h + self._attn_block(layer, h, n_head_local, tp_axis, sp_axis, attn_impl)
-            if cfg.n_experts:
-                h = h + self._moe_block(layer["moe"], _layer_norm(h, **layer["ln_2"]), tp_axis)
-            else:
-                h = h + self._mlp_block(layer["mlp"], _layer_norm(h, **layer["ln_2"]), tp_axis)
+        if pp_axis:
+            from dsml_tpu.parallel.pp import pipeline_apply
+
+            b = h.shape[0]
+            if b % n_micro:
+                raise ValueError(f"per-rank batch {b} not divisible by n_micro={n_micro}")
+            micro = h.reshape(n_micro, b // n_micro, *h.shape[1:])
+            outs = pipeline_apply(
+                lambda layer, x: self._block(layer, x, n_head_local, tp_axis, sp_axis, attn_impl),
+                params["layers"],
+                micro,
+                pp_axis,
+            )
+            h = outs.reshape(b, *h.shape[1:])
+        else:
+            for layer in params["layers"]:
+                h = self._block(layer, h, n_head_local, tp_axis, sp_axis, attn_impl)
 
         h = _layer_norm(h, **params["ln_f"])
         return h @ params["wte"].T  # tied unembedding → [b, s, vocab/tp]
+
+    def _block(self, layer, h, n_head_local, tp_axis, sp_axis, attn_impl):
+        """One transformer block (pre-LN attention + MLP/MoE residuals) —
+        the unit the pipeline schedule streams microbatches through."""
+        h = h + self._attn_block(layer, h, n_head_local, tp_axis, sp_axis, attn_impl)
+        if self.config.n_experts:
+            h = h + self._moe_block(layer["moe"], _layer_norm(h, **layer["ln_2"]), tp_axis)
+        else:
+            h = h + self._mlp_block(layer["mlp"], _layer_norm(h, **layer["ln_2"]), tp_axis)
+        return h
 
     def _attn_block(self, layer, h, n_head_local, tp_axis, sp_axis, attn_impl):
         cfg = self.config
@@ -333,15 +372,33 @@ class GPT2:
         tp_axis: str | None = None,
         sp_axis: str | None = None,
         attn_impl: str = "ring",
+        pp_axis: str | None = None,
+        n_micro: int = 1,
     ) -> jax.Array:
         """Mean next-token cross-entropy with vocab-sharded logits: the full
         [.., vocab] row never exists on one chip — logsumexp and the target
-        logit are combined across the tp axis."""
-        logits = self.apply_spmd(params, tokens, tp_axis, sp_axis, attn_impl).astype(jnp.float32)
+        logit are combined across the tp axis.
+
+        Under pipeline parallelism the head runs on replicated pipeline
+        outputs, but the loss is masked to the LAST stage and ``psum``-ed over
+        pp — so head/final-norm gradients land on exactly one rank (and the
+        embedding's on rank 0 via the pipeline feed mask), letting the caller
+        reconstruct full non-layer grads with one psum over pp
+        (``parallel.hybrid``)."""
+        logits = self.apply_spmd(
+            params, tokens, tp_axis, sp_axis, attn_impl, pp_axis=pp_axis, n_micro=n_micro
+        ).astype(jnp.float32)
+
+        def finalize(loss):
+            if pp_axis:
+                is_last = lax.axis_index(pp_axis) == lax.axis_size(pp_axis) - 1
+                loss = lax.psum(jnp.where(is_last, loss, 0.0), pp_axis)
+            return loss
+
         if not tp_axis:
             logp = jax.nn.log_softmax(logits)
             nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-            return nll.mean()
+            return finalize(nll.mean())
         vocab_shard = logits.shape[-1]
         tp_rank = lax.axis_index(tp_axis)
         # distributed logsumexp (max-shift carries no gradient, and pmax has
@@ -356,7 +413,7 @@ class GPT2:
         safe_ids = jnp.clip(local_ids, 0, vocab_shard - 1)
         tgt = jnp.take_along_axis(logits, safe_ids[..., None], axis=-1)
         tgt = lax.psum(jnp.where(in_shard[..., None], tgt, 0.0), tp_axis)
-        return jnp.mean(lse - tgt)
+        return finalize(jnp.mean(lse - tgt))
 
     # ---- single-device conveniences (parity + Trainer protocol) ----------------
 

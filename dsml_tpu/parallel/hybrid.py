@@ -1,13 +1,16 @@
-"""Hybrid-parallel training: dp × sp × tp in one jitted mesh program.
+"""Hybrid-parallel training: pp × dp × sp × tp in one jitted mesh program.
 
 The composable-mesh-axes design the reference's literature corpus points at
 (Megatron PTD-P, OneFlow SBP, Colossal-AI — SURVEY.md §2.3 "hybrid
 parallelism: literature only") realized for the transformer:
 
 - params enter TP-sharded (``GPT2.param_specs``), replicated over dp/sp;
+  with pp > 1 the layer stack is stage-sharded over 'pp' and runs as a
+  GPipe pipeline (``parallel.pp``) inside the same step;
 - the batch enters ``P('dp', 'sp')`` (batch rows over dp, sequence over sp);
 - inside ``shard_map``, the model runs Megatron TP psums + ring/Ulysses
-  sequence-parallel attention; gradients ``pmean`` over (dp, sp);
+  sequence-parallel attention; differentiation happens OUTSIDE shard_map so
+  every collective's transpose assigns cotangents exactly once;
 - the optimizer update runs OUTSIDE shard_map in the same jit — GSPMD
   propagates the param shardings through optax states automatically.
 
@@ -34,11 +37,16 @@ def shard_params(params, mesh: Mesh, specs) -> dict:
     )
 
 
-def hybrid_loss_fn(model, attn_impl: str = "ring") -> Callable:
+def hybrid_loss_fn(
+    model, attn_impl: str = "ring", pp_axis: str | None = None, n_micro: int = 1
+) -> Callable:
     """Per-rank loss closure for shard_map over the framework mesh axes."""
 
     def loss_fn(params, x, y):
-        return model.loss_spmd(params, x, y, tp_axis="tp", sp_axis="sp", attn_impl=attn_impl)
+        return model.loss_spmd(
+            params, x, y, tp_axis="tp", sp_axis="sp", attn_impl=attn_impl,
+            pp_axis=pp_axis, n_micro=n_micro,
+        )
 
     return loss_fn
 
@@ -49,6 +57,7 @@ def make_hybrid_train_step(
     mesh: Mesh,
     attn_impl: str = "ring",
     grad_accum: int = 1,
+    n_microbatches: int = 1,
 ):
     """Build ``step(params, opt_state, x, y) -> (params, opt_state, loss)``.
 
@@ -56,26 +65,43 @@ def make_hybrid_train_step(
     global batch is split into that many microbatches whose gradients
     accumulate on-device before one optimizer update (BASELINE.md's
     "data-parallel AllReduce + grad accumulation" config).
+
+    When the mesh has pp > 1, the transformer block stack additionally runs
+    as a GPipe pipeline of ``n_microbatches`` per step (params must be the
+    STACKED form from :func:`init_hybrid`): the full pp×dp×sp×tp hybrid.
     """
-    pspecs = model.param_specs()
+    pp_size = mesh.shape.get("pp", 1)
+    pp_axis = "pp" if pp_size > 1 else None
+    pspecs = model.param_specs(pp=bool(pp_axis))
     batch_spec = P("dp", "sp")
-    loss_fn = hybrid_loss_fn(model, attn_impl)
+    loss_fn = hybrid_loss_fn(model, attn_impl, pp_axis, n_microbatches)
     # value= lets loss-reactive transforms (utils.schedules.adaptive_plateau)
     # see the loss; the wrapper makes every optimizer accept it
     optimizer = optax.with_extra_args_support(optimizer)
 
-    def grads_fn(params, x, y):
-        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
-        grads = jax.tree.map(lambda g: lax.pmean(g, ("dp", "sp")), grads)
-        return lax.pmean(loss, ("dp", "sp")), grads
+    def total_loss(params, x, y):
+        # pmean over the batch axes so the per-rank value is the GLOBAL mean
+        # loss, replicated on every rank (tp ranks agree by construction of
+        # the vocab-sharded CE; pp ranks via the masked-head psum).
+        return lax.pmean(loss_fn(params, x, y), ("dp", "sp"))
 
-    sharded_grads = jax.shard_map(
-        grads_fn,
+    sharded_loss = jax.shard_map(
+        total_loss,
         mesh=mesh,
         in_specs=(pspecs, batch_spec, batch_spec),
-        out_specs=(P(), pspecs),
+        out_specs=P(),
         check_vma=False,
     )
+
+    def sharded_grads(params, x, y):
+        # Differentiate OUTSIDE shard_map: the outer grad seeds the
+        # replicated loss once and shard_map's transpose machinery assigns
+        # every collective's cotangent correctly (psum of per-rank
+        # contributions for replicated params, per-stage cotangents for
+        # pp-sharded layers). value_and_grad INSIDE shard_map would seed 1
+        # per rank and inflate every psum-crossing gradient by the axis size
+        # (tp, and pp's masked-head psum) — a silent n× lr scale.
+        return jax.value_and_grad(sharded_loss)(params, x, y)
 
     def step(params, opt_state, x, y):
         if grad_accum == 1:
@@ -106,7 +132,19 @@ def make_hybrid_train_step(
 
 
 def init_hybrid(model, optimizer, mesh: Mesh, seed: int = 0):
-    """Initialize (params, opt_state) already placed on the mesh."""
-    params = shard_params(model.init(seed), mesh, model.param_specs())
+    """Initialize (params, opt_state) already placed on the mesh. With
+    pp > 1 the layer list is stacked (leading layer axis) and stage-sharded
+    over 'pp'."""
+    params = model.init(seed)
+    pp = mesh.shape.get("pp", 1) > 1
+    if pp:
+        from dsml_tpu.parallel.pp import stack_layer_params
+
+        n_layer = len(params["layers"])
+        pp_size = mesh.shape["pp"]
+        if n_layer % pp_size:
+            raise ValueError(f"n_layer={n_layer} not divisible by pp={pp_size}")
+        params = {**params, "layers": stack_layer_params(params["layers"])}
+    params = shard_params(params, mesh, model.param_specs(pp=pp))
     opt_state = jax.jit(optimizer.init)(params)
     return params, opt_state

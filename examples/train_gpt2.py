@@ -2,10 +2,11 @@
 BASELINE.json config #5 ("TinyStories GPT-2-small, data-parallel AllReduce +
 grad accumulation").
 
-One jitted step over a dp×sp×tp mesh: Megatron tensor parallelism, ring (or
-Ulysses) sequence-parallel attention, data-parallel batch sharding with
-on-device gradient accumulation — the full hybrid-parallelism roadmap the
-reference carried only as literature (SURVEY.md §2.3).
+One jitted step over a pp×dp×sp×tp mesh: GPipe pipeline stages (``--pp``),
+Megatron tensor parallelism, ring (or Ulysses) sequence-parallel attention,
+data-parallel batch sharding with on-device gradient accumulation — the full
+hybrid-parallelism roadmap the reference carried only as literature
+(SURVEY.md §2.3).
 
 Token source: ``--data`` can point at any UTF-8 text file (e.g. a
 TinyStories dump). Without one (this container has no egress), a
@@ -41,6 +42,8 @@ class GPT2TrainConfig(Config):
     batch_size: int = field(8, help="GLOBAL batch size (rows per optimizer step)")
     seq_len: int = field(0, help="sequence length (0 = model max)")
     grad_accum: int = field(2, help="gradient-accumulation microbatches per step")
+    pp: int = field(1, help="pipeline-parallel stages")
+    n_micro: int = field(2, help="pipeline microbatches per step (pp > 1)")
     dp: int = field(0, help="data-parallel size (0 = derive from devices)")
     sp: int = field(1, help="sequence-parallel size")
     tp: int = field(1, help="tensor-parallel size")
@@ -92,8 +95,9 @@ def main(argv=None):
 
     log = get_logger("gpt2")
     devices = jax.devices()
-    dp = cfg.dp or max(len(devices) // (cfg.sp * cfg.tp), 1)
-    mesh = build_mesh(MeshSpec(dp=dp, sp=cfg.sp, tp=cfg.tp), devices[: dp * cfg.sp * cfg.tp])
+    dp = cfg.dp or max(len(devices) // (cfg.pp * cfg.sp * cfg.tp), 1)
+    n_used = cfg.pp * dp * cfg.sp * cfg.tp
+    mesh = build_mesh(MeshSpec(pp=cfg.pp, dp=dp, sp=cfg.sp, tp=cfg.tp), devices[:n_used])
 
     model_cfg = GPT2Config.small() if cfg.model == "small" else GPT2Config.tiny(vocab_size=256)
     if cfg.model == "tiny":
@@ -120,13 +124,14 @@ def main(argv=None):
 
     optimizer = optax.adamw(make_schedule("cosine", cfg.lr, cfg.steps, cfg.warmup_steps))
     step = make_hybrid_train_step(
-        model, optimizer, mesh, attn_impl=cfg.attn, grad_accum=cfg.grad_accum
+        model, optimizer, mesh, attn_impl=cfg.attn, grad_accum=cfg.grad_accum,
+        n_microbatches=cfg.n_micro,
     )
     params, opt_state = init_hybrid(model, optimizer, mesh, seed=cfg.seed)
     n_params = model.n_params(params)
     log.info(
-        "GPT-2 %s: %.1fM params, mesh dp=%d sp=%d tp=%d, seq=%d, batch=%d x accum=%d",
-        cfg.model, n_params / 1e6, dp, cfg.sp, cfg.tp, seq, cfg.batch_size, cfg.grad_accum,
+        "GPT-2 %s: %.1fM params, mesh pp=%d dp=%d sp=%d tp=%d, seq=%d, batch=%d x accum=%d",
+        cfg.model, n_params / 1e6, cfg.pp, dp, cfg.sp, cfg.tp, seq, cfg.batch_size, cfg.grad_accum,
     )
 
     rng = np.random.default_rng(cfg.seed)
