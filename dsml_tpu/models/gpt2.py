@@ -52,6 +52,10 @@ class GPT2Config:
     n_experts: int = 0
     expert_top_k: int = 2
     capacity_factor: float = 1.25
+    # rematerialization: recompute each block's activations in the backward
+    # pass instead of storing them — trades FLOPs for HBM (the memory-
+    # efficiency capability of the reference's §7 literature, ActNN/GACT)
+    remat: bool = False
 
     @staticmethod
     def small() -> "GPT2Config":
@@ -237,6 +241,12 @@ class GPT2:
             h = params["wte"][tokens]
         h = h + params["wpe"][pos]
 
+        def block(layer, x):
+            return self._block(layer, x, n_head_local, tp_axis, sp_axis, attn_impl)
+
+        if cfg.remat:
+            block = jax.checkpoint(block)
+
         if pp_axis:
             from dsml_tpu.parallel.pp import pipeline_apply
 
@@ -244,16 +254,11 @@ class GPT2:
             if b % n_micro:
                 raise ValueError(f"per-rank batch {b} not divisible by n_micro={n_micro}")
             micro = h.reshape(n_micro, b // n_micro, *h.shape[1:])
-            outs = pipeline_apply(
-                lambda layer, x: self._block(layer, x, n_head_local, tp_axis, sp_axis, attn_impl),
-                params["layers"],
-                micro,
-                pp_axis,
-            )
+            outs = pipeline_apply(block, params["layers"], micro, pp_axis)
             h = outs.reshape(b, *h.shape[1:])
         else:
             for layer in params["layers"]:
-                h = self._block(layer, h, n_head_local, tp_axis, sp_axis, attn_impl)
+                h = block(layer, h)
 
         h = _layer_norm(h, **params["ln_f"])
         return h @ params["wte"].T  # tied unembedding → [b, s, vocab/tp]

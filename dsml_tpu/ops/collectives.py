@@ -44,6 +44,7 @@ __all__ = [
     "ring_all_reduce",
     "naive_all_reduce",
     "all_reduce",
+    "hierarchical_all_reduce",
     "reduce_scatter",
     "all_gather",
     "all_to_all",
@@ -205,6 +206,56 @@ def all_reduce(
         return lax.pmax(x, axis_name)
     # XLA has no native product collective; fall back to the ring.
     return ring_all_reduce(x, axis_name, op)
+
+
+def hierarchical_all_reduce(
+    x: jax.Array,
+    inner_axis: str,
+    outer_axis: str,
+    op: ReduceOp = ReduceOp.SUM,
+    algorithm: str = "xla",
+) -> jax.Array:
+    """Topology-aware two-level all-reduce (Blink/TACOS-style hierarchical
+    collectives — the reference's §6 Communication literature, SURVEY.md
+    §2.4): reduce-scatter over the *inner* (fast, e.g. intra-slice ICI)
+    axis, all-reduce only 1/n_inner of the payload over the *outer* (slow,
+    e.g. DCN) axis, then all-gather back over the inner axis. The slow hop
+    carries n_inner× less data than a flat all-reduce over both axes.
+
+    Result equals ``all_reduce`` over both axes for every :class:`ReduceOp`.
+    """
+    op = ReduceOp(op)
+    n_inner = _axis_size(inner_axis)
+    if n_inner == 1:
+        return all_reduce(x, outer_axis, op, algorithm)
+    inner_op = outer_op = op
+    if op == ReduceOp.AVG:
+        # average exactly once: SUM through both levels, divide at the end
+        inner_op = outer_op = ReduceOp.SUM
+    orig_shape, orig_dtype = x.shape, x.dtype
+    acc_dtype = (
+        jnp.promote_types(orig_dtype, jnp.int32)
+        if jnp.issubdtype(orig_dtype, jnp.integer)
+        else orig_dtype
+    )
+    flat = x.astype(acc_dtype).reshape(-1)
+    size = flat.shape[0]
+    padded = -(-size // n_inner) * n_inner
+    if padded != size:
+        # pad with the op's identity so pad lanes can't perturb real lanes
+        if jnp.issubdtype(acc_dtype, jnp.floating):
+            hi, lo = jnp.inf, -jnp.inf
+        else:
+            info = jnp.iinfo(acc_dtype)
+            hi, lo = info.max, info.min
+        pad_val = {ReduceOp.PROD: 1, ReduceOp.MIN: hi, ReduceOp.MAX: lo}.get(op, 0)
+        flat = jnp.pad(flat, (0, padded - size), constant_values=pad_val)
+    shard = reduce_scatter(flat.reshape(n_inner, padded // n_inner), inner_axis, inner_op)
+    shard = all_reduce(shard, outer_axis, outer_op, algorithm)
+    out = lax.all_gather(shard, inner_axis, axis=0, tiled=False).reshape(-1)[:size]
+    if op == ReduceOp.AVG:
+        out = out / (n_inner * _axis_size(outer_axis))
+    return out.reshape(orig_shape).astype(orig_dtype)
 
 
 def reduce_scatter(x: jax.Array, axis_name: str, op: ReduceOp = ReduceOp.SUM) -> jax.Array:

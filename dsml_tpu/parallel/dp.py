@@ -14,6 +14,9 @@ batch is sharded across the ``dp`` mesh axis and gradients genuinely sync:
   AllReduceRing schedule with honest semantics, usable end-to-end in
   training (BASELINE.md config: "MNIST MLP, 4 TPU devices, ring AllReduce").
 - ``algorithm="naive"`` — gather-everything baseline, for benchmarks.
+- ``algorithm="q8"``   — 8-bit compressed sync: per-rank gradients quantize
+  to blockwise int8 with stochastic rounding before the exchange (≈4× fewer
+  wire bytes; unbiased — ``dsml_tpu.ops.quantization``).
 """
 
 from __future__ import annotations
@@ -62,7 +65,20 @@ def make_dp_train_step(
             def shard_fn(params, x, y):
                 loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
                 flat, unravel = ravel_pytree(grads)
-                flat = all_reduce(flat, axis, ReduceOp.AVG, algorithm)
+                if algorithm == "q8":
+                    from dsml_tpu.ops.quantization import compressed_all_reduce
+
+                    # data-dependent seed: the dither pattern must vary per
+                    # step or slowly-moving coordinates see the same rounding
+                    # direction every step (systematic bias). Hashing the
+                    # gradient bits decorrelates steps without threading a
+                    # counter through the step signature.
+                    seed = jnp.sum(
+                        jax.lax.bitcast_convert_type(flat, jnp.int32), dtype=jnp.int32
+                    )
+                    flat = compressed_all_reduce(flat, axis, seed=seed, mean=True)
+                else:
+                    flat = all_reduce(flat, axis, ReduceOp.AVG, algorithm)
                 return jax.lax.pmean(loss, axis), unravel(flat)
 
             return jax.shard_map(

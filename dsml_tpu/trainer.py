@@ -37,7 +37,7 @@ class TrainConfig(Config):
     warmup_steps: int = field(0, help="linear warmup steps for the schedule")
     plateau_patience: int = field(5, help="plateau schedule: epochs-worth of steps without improvement before decaying")
     plateau_factor: float = field(0.5, help="plateau schedule: lr decay factor")
-    algorithm: str = field("xla", help="gradient sync: xla | ring | naive")
+    algorithm: str = field("xla", help="gradient sync: xla | ring | naive | q8 (int8-compressed)")
     dp: int = field(0, help="data-parallel devices (0 = all local)")
     seed: int = field(0, help="init + shuffle seed")
     log_metrics: str = field("", help="optional JSONL metrics path")

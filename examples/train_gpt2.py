@@ -99,6 +99,24 @@ def main(argv=None):
     n_used = cfg.pp * dp * cfg.sp * cfg.tp
     mesh = build_mesh(MeshSpec(pp=cfg.pp, dp=dp, sp=cfg.sp, tp=cfg.tp), devices[:n_used])
 
+    # the batch must split evenly: global batch → grad_accum microbatches →
+    # dp shards → (pp>1) pipeline microbatches
+    if cfg.batch_size % (cfg.grad_accum * dp):
+        raise SystemExit(
+            f"batch_size={cfg.batch_size} must be divisible by grad_accum*dp="
+            f"{cfg.grad_accum * dp} (use --batch_size {cfg.grad_accum * dp * 2})"
+        )
+    n_micro = cfg.n_micro
+    if cfg.pp > 1:
+        rows_per_rank = cfg.batch_size // (cfg.grad_accum * dp)
+        while rows_per_rank % n_micro:
+            n_micro -= 1  # largest feasible microbatch count ≥ 1
+        if n_micro != cfg.n_micro:
+            print(
+                f"note: n_micro={cfg.n_micro} does not divide the {rows_per_rank} "
+                f"rows per dp rank; using n_micro={n_micro}"
+            )
+
     model_cfg = GPT2Config.small() if cfg.model == "small" else GPT2Config.tiny(vocab_size=256)
     if cfg.model == "tiny":
         model_cfg = dataclasses.replace(model_cfg, vocab_size=256)  # byte tokens
@@ -125,7 +143,7 @@ def main(argv=None):
     optimizer = optax.adamw(make_schedule("cosine", cfg.lr, cfg.steps, cfg.warmup_steps))
     step = make_hybrid_train_step(
         model, optimizer, mesh, attn_impl=cfg.attn, grad_accum=cfg.grad_accum,
-        n_microbatches=cfg.n_micro,
+        n_microbatches=n_micro,
     )
     params, opt_state = init_hybrid(model, optimizer, mesh, seed=cfg.seed)
     n_params = model.n_params(params)

@@ -252,6 +252,40 @@ def test_tp_logits_match_single_device_exactly(devices8):
     np.testing.assert_allclose(got, expected, rtol=1e-3, atol=2e-4)
 
 
+def test_remat_gradients_identical(hybrid_mesh):
+    """jax.checkpoint on each block must change memory, never math — grads
+    with and without remat are bit-comparable, incl. on the hybrid mesh."""
+    import dataclasses
+
+    from dsml_tpu.parallel.hybrid import hybrid_loss_fn, shard_params
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    cfg = GPT2Config.tiny()
+    x, y = _batch(cfg, seed=31)
+    base = GPT2(cfg)
+    remat = GPT2(dataclasses.replace(cfg, remat=True))
+    params = base.init(30)
+
+    g0 = jax.jit(jax.grad(base.loss))(params, x, y)
+    g1 = jax.jit(jax.grad(remat.loss))(params, x, y)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+
+    # and through the sharded hybrid loss
+    sharded = jax.shard_map(
+        lambda p, xx, yy: lax.pmean(hybrid_loss_fn(remat)(p, xx, yy), ("dp", "sp")),
+        mesh=hybrid_mesh,
+        in_specs=(remat.param_specs(), P("dp", "sp"), P("dp", "sp")),
+        out_specs=P(),
+        check_vma=False,
+    )
+    placed = shard_params(params, hybrid_mesh, remat.param_specs())
+    gs = jax.jit(jax.grad(sharded))(placed, x, y)
+    for a, b in zip(jax.tree.leaves(gs), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5)
+
+
 def test_tp_requires_divisible_heads(devices8):
     cfg = GPT2Config(vocab_size=512, max_seq=64, n_layer=1, n_head=6, d_model=48, d_ff=96)
     model = GPT2(cfg)

@@ -1,0 +1,104 @@
+"""Int8 stochastic quantization + compressed gradient sync, and the
+hierarchical two-level all-reduce (communication/memory literature parity,
+SURVEY.md §2.4 folders 6-7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dsml_tpu.ops.collectives import ReduceOp, all_reduce, hierarchical_all_reduce
+from dsml_tpu.ops.quantization import compressed_all_reduce, dequantize_int8, quantize_int8
+
+
+def test_quantize_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((1000,)) * 3.0, jnp.float32)
+    qt = quantize_int8(x, seed=1)
+    back = dequantize_int8(qt)
+    assert back.shape == x.shape and back.dtype == x.dtype
+    # per-block absmax scaling bounds the element error by one quantum
+    scale_per_elem = np.repeat(np.asarray(qt.scales)[:, 0], qt.values.shape[1])[:1000]
+    assert np.all(np.abs(np.asarray(back - x)) <= scale_per_elem + 1e-6)
+
+
+def test_quantize_stochastic_rounding_unbiased():
+    """Averaging many independently-seeded round-trips must converge to x —
+    the property that keeps compressed gradients from biasing SGD."""
+    x = jnp.full((512,), 0.303, jnp.float32)  # deliberately between quanta
+    reps = 200
+    acc = np.zeros(512, np.float64)
+    for s in range(reps):
+        acc += np.asarray(dequantize_int8(quantize_int8(x, seed=s)), np.float64)
+    mean_err = np.abs(acc / reps - 0.303).max()
+    scale = float(quantize_int8(x, seed=0).scales.max())
+    assert mean_err < 0.2 * scale, (mean_err, scale)  # deterministic rounding would sit at ~0.5 quanta
+
+
+def test_quantized_values_in_range():
+    x = jnp.asarray(np.random.default_rng(1).standard_normal(2048) * 100, jnp.float32)
+    qt = quantize_int8(x, seed=2)
+    v = np.asarray(qt.values)
+    assert v.dtype == np.int8 and v.min() >= -127 and v.max() <= 127
+
+
+def test_compressed_all_reduce_close_to_exact(mesh8):
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((8, 4096)).astype(np.float32)
+    exact = x.mean(axis=0)
+
+    got = jax.jit(
+        jax.shard_map(
+            lambda s: compressed_all_reduce(s[0], "dev", seed=7)[None],
+            mesh=mesh8, in_specs=P("dev"), out_specs=P("dev"), check_vma=False,
+        )
+    )(jnp.asarray(x))
+    got0 = np.asarray(got)[0]
+    # every rank's copy equals the same compressed mean
+    scale_bound = np.abs(x).max() / 127.0
+    assert np.abs(got0 - exact).max() < scale_bound, (np.abs(got0 - exact).max(), scale_bound)
+
+
+def test_q8_training_converges(dp_mesh8):
+    from dsml_tpu.models.mlp import MLP
+    from dsml_tpu.trainer import TrainConfig, Trainer
+    from dsml_tpu.utils.data import synthetic_classification
+
+    data = synthetic_classification(512, 64, classes=4, seed=0)
+    cfg = TrainConfig(epochs=3, batch_size=64, lr=0.05, optimizer="momentum", algorithm="q8")
+    trainer = Trainer(MLP(sizes=(64, 32, 4)), cfg, mesh=dp_mesh8)
+    _, history, test_acc = trainer.train(data)
+    assert history[-1]["avg_loss"] < history[0]["avg_loss"]
+    assert test_acc > 0.8
+
+
+@pytest.mark.parametrize("grid", [(2, 4), (4, 2)])
+@pytest.mark.parametrize("op", [ReduceOp.SUM, ReduceOp.AVG, ReduceOp.MAX, ReduceOp.PROD])
+def test_hierarchical_all_reduce_matches_flat(devices8, grid, op):
+    n_outer, n_inner = grid
+    mesh = Mesh(np.asarray(devices8).reshape(n_outer, n_inner), ("o", "i"))
+    rng = np.random.default_rng(4)
+    # 1000 elements: NOT divisible by n_inner → exercises identity padding
+    x = rng.uniform(0.5, 1.5, size=(8, 1000)).astype(np.float32)
+
+    def flat_ref(op):
+        if op == ReduceOp.SUM:
+            return x.sum(axis=0)
+        if op == ReduceOp.AVG:
+            return x.mean(axis=0)
+        if op == ReduceOp.MAX:
+            return x.max(axis=0)
+        return np.prod(x, axis=0)
+
+    got = jax.jit(
+        jax.shard_map(
+            lambda s: hierarchical_all_reduce(s[0, 0], "i", "o", op)[None, None],
+            mesh=mesh,
+            in_specs=P("o", "i"),
+            out_specs=P("o", "i"),
+            check_vma=False,
+        )
+    )(jnp.asarray(x).reshape(n_outer, n_inner, 1000))
+    got0 = np.asarray(got).reshape(8, 1000)[0]
+    np.testing.assert_allclose(got0, flat_ref(op), rtol=2e-5, atol=2e-5)
