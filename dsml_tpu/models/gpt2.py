@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from dsml_tpu.ops.attention import attention, ring_attention, ulysses_attention
+from dsml_tpu.ops.attention import _NEG_INF, attention, ring_attention, ulysses_attention
 
 __all__ = ["GPT2Config", "GPT2"]
 
@@ -274,19 +274,8 @@ class GPT2:
         return h
 
     def _attn_block(self, layer, h, n_head_local, tp_axis, sp_axis, attn_impl):
-        cfg = self.config
         x = _layer_norm(h, **layer["ln_1"])
-        # wqkv local shard: [d, 3, d/tp] — slot axis separates q/k/v so the
-        # TP shard on the last dim is purely a head split
-        qkv = jnp.einsum("bsd,dke->bske", x, layer["attn"]["wqkv"]) + layer["attn"]["bqkv"]
-        d_local = n_head_local * (cfg.d_model // cfg.n_head)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-
-        def heads(t):  # [b, s, d_local] -> [b, h_local, s, hd]
-            b, s, _ = t.shape
-            return t.reshape(b, s, n_head_local, -1).transpose(0, 2, 1, 3)
-
-        q, k, v = heads(q), heads(k), heads(v)
+        q, k, v = self._qkv_heads(layer, x, n_head_local)
         if sp_axis:
             # sequence is sharded: only ring/Ulysses see the full context.
             # Anything else (incl. "flash", a single-chip kernel) would be
@@ -301,9 +290,7 @@ class GPT2:
             out = flash_attention(q, k, v, causal=True)
         else:
             out = attention(q, k, v, causal=True)
-        b, _, s, _ = out.shape
-        out = out.transpose(0, 2, 1, 3).reshape(b, s, d_local)
-        out = out @ layer["attn"]["wo"]  # row-parallel → partial sums
+        out = self._merge_heads(out) @ layer["attn"]["wo"]  # row-parallel → partial sums
         if tp_axis:
             out = lax.psum(out, tp_axis)  # Megatron psum #1
         return out + layer["attn"]["bo"]
@@ -427,3 +414,148 @@ class GPT2:
 
     def loss(self, params: dict, tokens: jax.Array, targets: jax.Array) -> jax.Array:
         return self.loss_spmd(params, tokens, targets)
+
+    # ---- autoregressive decoding (KV cache) ------------------------------------
+    # The reference has no inference path at all; a serving-shaped decode loop
+    # is table stakes for a framework. Static shapes throughout: the cache is
+    # pre-allocated at max_seq and positions are masked, so prefill + every
+    # decode step are fixed-shape XLA programs (one compile each).
+
+    def init_cache(self, batch: int) -> list:
+        cfg = self.config
+        hd = cfg.d_model // cfg.n_head
+        dt = jnp.dtype(cfg.dtype)
+        return [
+            {
+                "k": jnp.zeros((batch, cfg.n_head, cfg.max_seq, hd), dt),
+                "v": jnp.zeros((batch, cfg.n_head, cfg.max_seq, hd), dt),
+            }
+            for _ in range(cfg.n_layer)
+        ]
+
+    def _qkv_heads(self, layer, x, n_head_local: int | None = None):
+        """Fused QKV projection + head split. ``layer['attn']['wqkv']`` is
+        [d, 3, d(/tp)] — the slot axis separates q/k/v so a TP shard of the
+        last dim is purely a head split; ``n_head_local`` is the head count
+        actually present in this shard (full ``n_head`` when unsharded)."""
+        n_head_local = n_head_local or self.config.n_head
+        qkv = jnp.einsum("bsd,dke->bske", x, layer["attn"]["wqkv"]) + layer["attn"]["bqkv"]
+
+        def heads(t):  # [b, s, d_local] -> [b, h_local, s, hd]
+            b, s, _ = t.shape
+            return t.reshape(b, s, n_head_local, -1).transpose(0, 2, 1, 3)
+
+        return heads(qkv[:, :, 0]), heads(qkv[:, :, 1]), heads(qkv[:, :, 2])
+
+    def _merge_heads(self, t):  # [b, H, s, hd] -> [b, s, d]
+        b, _, s, _ = t.shape
+        return t.transpose(0, 2, 1, 3).reshape(b, s, -1)
+
+    def _ffn(self, layer, h):
+        if self.config.n_experts:
+            return h + self._moe_block(layer["moe"], _layer_norm(h, **layer["ln_2"]), None)
+        return h + self._mlp_block(layer["mlp"], _layer_norm(h, **layer["ln_2"]), None)
+
+    def prefill(self, params: dict, tokens: jax.Array):
+        """Run the prompt [batch, T] in ONE pass, filling the cache.
+        Returns (last-position logits [batch, vocab], cache)."""
+        cfg = self.config
+        b, t = tokens.shape
+        h = params["wte"][tokens] + params["wpe"][jnp.arange(t)]
+        cache = self.init_cache(b)
+        for i, layer in enumerate(params["layers"]):
+            x = _layer_norm(h, **layer["ln_1"])
+            q, k, v = self._qkv_heads(layer, x)
+            out = attention(q, k, v, causal=True)
+            h = h + self._merge_heads(out) @ layer["attn"]["wo"] + layer["attn"]["bo"]
+            h = self._ffn(layer, h)
+            cache[i] = {
+                "k": lax.dynamic_update_slice(cache[i]["k"], k, (0, 0, 0, 0)),
+                "v": lax.dynamic_update_slice(cache[i]["v"], v, (0, 0, 0, 0)),
+            }
+        h = _layer_norm(h, **params["ln_f"])
+        return h[:, -1] @ params["wte"].T, cache
+
+    def decode_step(self, params: dict, cache: list, tokens: jax.Array, pos: jax.Array):
+        """One decode step: ``tokens`` [batch] at position ``pos`` (scalar).
+        Returns (logits [batch, vocab], updated cache)."""
+        cfg = self.config
+        b = tokens.shape[0]
+        h = params["wte"][tokens][:, None, :] + params["wpe"][pos][None, None, :]
+        valid = jnp.arange(cfg.max_seq) <= pos  # attend to cache[0..pos]
+        new_cache = []
+        for layer, c in zip(params["layers"], cache):
+            x = _layer_norm(h, **layer["ln_1"])
+            q, k, v = self._qkv_heads(layer, x)  # [b, H, 1, hd]
+            ck = lax.dynamic_update_slice(c["k"], k, (0, 0, pos, 0))
+            cv = lax.dynamic_update_slice(c["v"], v, (0, 0, pos, 0))
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck) * (q.shape[-1] ** -0.5)
+            scores = jnp.where(valid[None, None, None, :], scores, _NEG_INF)
+            out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), cv)
+            h = h + self._merge_heads(out) @ layer["attn"]["wo"] + layer["attn"]["bo"]
+            h = self._ffn(layer, h)
+            new_cache.append({"k": ck, "v": cv})
+        h = _layer_norm(h, **params["ln_f"])
+        return h[:, 0] @ params["wte"].T, new_cache
+
+    def generate(
+        self,
+        params: dict,
+        prompt: jax.Array,  # [batch, T] int32
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        seed: int = 0,
+    ) -> jax.Array:
+        """Sample ``max_new_tokens`` continuations. ``temperature == 0`` is
+        greedy; otherwise softmax sampling, optionally truncated to the
+        ``top_k`` most likely tokens. Returns [batch, max_new_tokens]."""
+        cfg = self.config
+        b, t = prompt.shape
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if t + max_new_tokens > cfg.max_seq:
+            raise ValueError(
+                f"prompt ({t}) + max_new_tokens ({max_new_tokens}) exceeds max_seq={cfg.max_seq}"
+            )
+        run = self._generate_fn(t, max_new_tokens, float(temperature), int(top_k))
+        return run(params, prompt.astype(jnp.int32), jax.random.PRNGKey(seed))
+
+    def _generate_fn(self, prompt_len: int, max_new_tokens: int, temperature: float, top_k: int):
+        """Compiled generate program, cached per (prompt_len, max_new,
+        temperature, top_k) so repeated serving calls don't re-trace."""
+        key_ = (prompt_len, max_new_tokens, temperature, top_k)
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        if key_ in cache:
+            return cache[key_]
+
+        def sample(logits, key):
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits = logits.astype(jnp.float32) / temperature
+            if top_k > 0:
+                kth = lax.top_k(logits, top_k)[0][..., -1:]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+        @jax.jit
+        def run(params, prompt, key):
+            logits, kv = self.prefill(params, prompt)
+            key, sub = jax.random.split(key)
+            first = sample(logits, sub)
+
+            def body(carry, _):
+                kv, tok, pos, key = carry
+                logits, kv = self.decode_step(params, kv, tok, pos)
+                key, sub = jax.random.split(key)
+                nxt = sample(logits, sub)
+                return (kv, nxt, pos + 1, key), nxt
+
+            carry = (kv, first, jnp.asarray(prompt_len, jnp.int32), key)
+            _, rest = lax.scan(body, carry, None, length=max_new_tokens - 1)
+            return jnp.concatenate([first[None], rest], axis=0).T  # [b, max_new]
+
+        cache[key_] = run
+        return run

@@ -17,3 +17,40 @@ def configure_platform(platform: str = "", cpu_devices: int = 0) -> None:
         jax.config.update("jax_platforms", platform)
     if cpu_devices:
         jax.config.update("jax_num_cpu_devices", cpu_devices)
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> int:
+    """Join a multi-host JAX cluster (DCN scale-out) and return this
+    process's index.
+
+    The mesh/collective layers are host-count-agnostic: ``jax.devices()``
+    spans every host after this call, so the same ``build_mesh`` +
+    ``shard_map`` programs run across pods — DCN traffic is inserted by XLA
+    where mesh axes cross hosts (SURVEY.md §5.8's "TPU-native equivalent").
+    On TPU pods all three arguments auto-detect from the environment; pass
+    them explicitly elsewhere (e.g. CPU clusters for tests).
+
+    No-op (returns 0) when num_processes == 1 or JAX was already
+    initialized for this cluster.
+    """
+    import jax
+
+    if num_processes == 1:
+        return 0
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:  # double-init → idempotent no-op
+        # jax 0.9 phrases this "distributed.initialize should only be called
+        # once."; older versions said "already initialized"
+        msg = str(e).lower()
+        if "once" not in msg and "already" not in msg:
+            raise
+    return jax.process_index()

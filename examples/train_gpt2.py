@@ -37,6 +37,8 @@ class GPT2TrainConfig(Config):
     platform: str = field("", help="jax platform override: cpu|tpu ('' = default)")
     cpu_devices: int = field(0, help="virtual CPU device count for --platform cpu")
     model: str = field("tiny", help="tiny | small (125M, the BASELINE config)")
+    dtype: str = field("", help="params/activations dtype: float32 | bfloat16 ('' = model default; bfloat16 feeds the MXU at full rate on TPU)")
+    remat: bool = field(False, help="rematerialize each block's activations in backward (less HBM, more FLOPs)")
     data: str = field("", help="UTF-8 text file to train on ('' = generated stories)")
     steps: int = field(50, help="optimizer steps")
     batch_size: int = field(8, help="GLOBAL batch size (rows per optimizer step)")
@@ -120,6 +122,10 @@ def main(argv=None):
     model_cfg = GPT2Config.small() if cfg.model == "small" else GPT2Config.tiny(vocab_size=256)
     if cfg.model == "tiny":
         model_cfg = dataclasses.replace(model_cfg, vocab_size=256)  # byte tokens
+    if cfg.dtype:
+        model_cfg = dataclasses.replace(model_cfg, dtype=cfg.dtype)
+    if cfg.remat:
+        model_cfg = dataclasses.replace(model_cfg, remat=True)
     model = GPT2(model_cfg)
     seq = cfg.seq_len or model_cfg.max_seq
 

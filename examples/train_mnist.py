@@ -26,6 +26,7 @@ class MNISTConfig(TrainConfig):
     platform: str = field("", help="jax platform override: cpu|tpu ('' = default)")
     cpu_devices: int = field(0, help="virtual CPU device count for --platform cpu")
     data_dir: str = field("data/mnist", help="IDX data directory")
+    model: str = field("mlp", help="mlp | cnn (BASELINE config 3: CNN + psum gradient sync)")
     hidden: tuple[int, ...] = field(default_factory=lambda: (128, 64),
                                     help="hidden layer sizes (reference README documents 128,64)")
 
@@ -36,12 +37,13 @@ def main(argv=None):
 
     configure_platform(cfg.platform, cfg.cpu_devices)
 
+    from dsml_tpu.models.cnn import CNN
     from dsml_tpu.models.mlp import MLP
     from dsml_tpu.trainer import Trainer
     from dsml_tpu.utils.data import load_mnist
 
     data = load_mnist(cfg.data_dir)
-    model = MLP(sizes=(784, *cfg.hidden, 10))
+    model = CNN() if cfg.model == "cnn" else MLP(sizes=(784, *cfg.hidden, 10))
     trainer = Trainer(model, cfg)
     _, _, test_acc = trainer.train(data)
     return test_acc

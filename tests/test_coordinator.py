@@ -129,6 +129,30 @@ def test_ring_all_reduce_honors_mem_addrs(cluster):
     np.testing.assert_array_equal(bytes_to_f32(client.read(1, 0x5000, 64)), np.full(16, 5.0))
 
 
+def test_concurrent_communicators_are_independent(cluster):
+    """Two live communicators over disjoint device sets (untested in the
+    reference, SURVEY.md §4.4 'concurrent communicators'): collectives on one
+    must not leak into or fail the other."""
+    devices, coordinator = cluster
+    addrs = [d.address for d in devices]
+    a = PipelineClient.connect(coordinator.address, addrs[:4])
+    b = PipelineClient.connect(coordinator.address, addrs[4:])
+    assert a.comm_id != b.comm_id
+
+    rng = np.random.default_rng(7)
+    grads_a = [rng.standard_normal(257).astype(np.float32) for _ in range(4)]
+    grads_b = [rng.standard_normal(257).astype(np.float32) for _ in range(4)]
+    red_a = a.all_reduce_gradients(grads_a)
+    red_b = b.all_reduce_gradients(grads_b)
+    np.testing.assert_allclose(red_a, np.sum(grads_a, axis=0), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(red_b, np.sum(grads_b, axis=0), rtol=1e-4, atol=1e-5)
+    assert a.status() == pb.SUCCESS and b.status() == pb.SUCCESS
+    # destroying one must not kill the other
+    a.coordinator.CommDestroy(pb.CommDestroyRequest(commId=a.comm_id))
+    red_b2 = b.all_reduce_gradients(grads_b)
+    np.testing.assert_allclose(red_b2, np.sum(grads_b, axis=0), rtol=1e-4, atol=1e-5)
+
+
 def test_all_reduce_unknown_comm_not_found(cluster):
     client = _connect(cluster, n=2)
     with pytest.raises(grpc.RpcError) as e:
