@@ -72,6 +72,7 @@ class StreamState:
     chunks: list[bytes] = field(default_factory=list)
     received: int = 0
     armed: bool = False  # BeginReceive seen
+    sender_done: bool = False  # StreamSend finished delivering
 
 
 class BufferRegistry:
@@ -258,11 +259,15 @@ class DeviceRuntime:
             return False
         with self._stream_lock:
             st = self.streams[stream_id]
+            st.sender_done = True
             return self._maybe_complete_locked(st, final=True)
 
     def _maybe_complete_locked(self, st: StreamState, final: bool = False) -> bool:
         if not st.armed or st.recv_addr is None:
             return True  # waiting for BeginReceive; chunks stay buffered
+        # a late BeginReceive must still see that the sender already finished
+        # (otherwise an under-delivered stream would stay IN_PROGRESS forever)
+        final = final or st.sender_done
         if st.received == st.num_bytes and st.num_bytes > 0:
             data = b"".join(st.chunks)
             st.chunks = []  # payload now lives in the registry; don't retain it

@@ -518,6 +518,10 @@ class GPT2:
             raise ValueError(
                 f"prompt ({t}) + max_new_tokens ({max_new_tokens}) exceeds max_seq={cfg.max_seq}"
             )
+        if top_k < 0 or top_k > cfg.vocab_size:
+            raise ValueError(f"top_k must be in [0, vocab_size={cfg.vocab_size}], got {top_k}")
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
         run = self._generate_fn(t, max_new_tokens, float(temperature), int(top_k))
         return run(params, prompt.astype(jnp.int32), jax.random.PRNGKey(seed))
 

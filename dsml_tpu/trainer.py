@@ -20,7 +20,7 @@ import optax
 from dsml_tpu.parallel.dp import make_dp_train_step, make_eval_step
 from dsml_tpu.parallel.mesh import data_mesh
 from dsml_tpu.utils.config import Config, field
-from dsml_tpu.utils.data import Dataset, shard_batches
+from dsml_tpu.utils.data import Dataset, prefetch_batches, shard_batches
 from dsml_tpu.utils.logging import get_logger
 from dsml_tpu.utils.metrics import EpochMetrics, MetricsLogger
 
@@ -125,7 +125,10 @@ class Trainer:
             # dispatch of step k+1 overlaps execution of step k without the
             # in-flight queue growing unboundedly
             sync_every = 32
-            for x, y in shard_batches(data.train_x, data.train_y, cfg.batch_size, seed=cfg.seed + epoch):
+            batches = prefetch_batches(
+                shard_batches(data.train_x, data.train_y, cfg.batch_size, seed=cfg.seed + epoch)
+            )
+            for x, y in batches:
                 params, opt_state, loss = self._step_fn(params, opt_state, x, y)
                 losses.append(loss)
                 if len(losses) % sync_every == 0:

@@ -131,6 +131,54 @@ def synthetic_classification(
     return Dataset(x[:split], y[:split], x[split:], y[split:])
 
 
+def prefetch_batches(iterator, depth: int = 2):
+    """Run ``iterator`` in a background thread, keeping up to ``depth``
+    batches ready — host-side batch assembly (shuffle-gather, the pure-numpy
+    cost of :func:`shard_batches`) overlaps device compute instead of
+    serializing with it. The reference's client assembled batches inline on
+    the training thread (``client.go:592-603``)."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    _END = object()
+
+    def put(item) -> bool:
+        # never block forever: if the consumer abandoned the generator
+        # (exception mid-epoch), the worker must exit, not pin the thread
+        # and `depth` batches of host memory
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in iterator:
+                if not put(item):
+                    return
+            put(_END)
+        except BaseException as e:  # noqa: BLE001 — surface on the consumer side
+            put(e)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
 def shard_batches(
     x: np.ndarray,
     y: np.ndarray,

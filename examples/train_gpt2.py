@@ -54,6 +54,7 @@ class GPT2TrainConfig(Config):
     warmup_steps: int = field(10, help="linear warmup steps")
     seed: int = field(0, help="init/data seed")
     log_every: int = field(10, help="log every N steps")
+    profile_dir: str = field("", help="write a jax.profiler (TensorBoard) trace of the run here")
 
 
 _WORDS = {
@@ -158,20 +159,26 @@ def main(argv=None):
         cfg.model, n_params / 1e6, cfg.pp, dp, cfg.sp, cfg.tp, seq, cfg.batch_size, cfg.grad_accum,
     )
 
+    import contextlib
+
+    from dsml_tpu.utils.tracing import trace
+
     rng = np.random.default_rng(cfg.seed)
     t0 = time.monotonic()
     tokens_done = 0
     first_loss = None
-    for i in range(1, cfg.steps + 1):
-        x, y = sample_batch(rng)
-        params, opt_state, loss = step(params, opt_state, x, y)
-        tokens_done += x.size
-        if first_loss is None:
-            first_loss = float(loss)
-        if i % cfg.log_every == 0 or i == cfg.steps:
-            loss_f = float(loss)
-            tps = tokens_done / max(time.monotonic() - t0, 1e-9)
-            log.info("step %d: loss = %.4f, %.0f tokens/s", i, loss_f, tps)
+    profiler = trace(cfg.profile_dir) if cfg.profile_dir else contextlib.nullcontext()
+    with profiler:
+        for i in range(1, cfg.steps + 1):
+            x, y = sample_batch(rng)
+            params, opt_state, loss = step(params, opt_state, x, y)
+            tokens_done += x.size
+            if first_loss is None:
+                first_loss = float(loss)
+            if i % cfg.log_every == 0 or i == cfg.steps:
+                loss_f = float(loss)
+                tps = tokens_done / max(time.monotonic() - t0, 1e-9)
+                log.info("step %d: loss = %.4f, %.0f tokens/s", i, loss_f, tps)
     return {"first_loss": first_loss, "last_loss": float(loss)}
 
 

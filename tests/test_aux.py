@@ -120,3 +120,20 @@ def test_elastic_recovery_survives_device_loss(devices8):
         coordinator.stop()
         for d in (devices[0], devices[2]):
             d.stop()
+
+
+def test_prefetch_batches_preserves_order_and_errors():
+    from dsml_tpu.utils.data import prefetch_batches
+
+    assert list(prefetch_batches(iter(range(20)), depth=3)) == list(range(20))
+
+    def boom():
+        yield 1
+        raise RuntimeError("loader died")
+
+    it = prefetch_batches(boom())
+    assert next(it) == 1
+    import pytest
+
+    with pytest.raises(RuntimeError, match="loader died"):
+        list(it)

@@ -225,6 +225,75 @@ def test_p2p_stream_crosses_devices(cluster):
     assert client.read(1, 0x2000, len(payload)) == payload
 
 
+def test_stream_length_mismatch_fails_over_network(cluster):
+    """Stream error path over real gRPC (untested in the reference, §4.4):
+    receiver expects more bytes than the sender ships → stream FAILED, and
+    the receive buffer is never written."""
+    client = _connect(cluster, n=2)
+    payload = np.random.default_rng(8).bytes(1000)
+    client.write(0, 0x1000, payload)
+    send = client.devices[0].BeginSend(
+        pb.BeginSendRequest(
+            sendBuffAddr=pb.MemAddr(value=0x1000), numBytes=len(payload), dstRank=pb.Rank(value=1)
+        )
+    )
+    sid = send.streamId.value
+    client.devices[1].BeginReceive(
+        pb.BeginReceiveRequest(
+            streamId=pb.StreamId(value=sid),
+            recvBuffAddr=pb.MemAddr(value=0x2000),
+            numBytes=len(payload) * 2,  # expects double what will arrive
+            srcRank=pb.Rank(value=0),
+        )
+    )
+    deadline = time.monotonic() + 10
+    status = pb.IN_PROGRESS
+    while time.monotonic() < deadline:
+        status = client.devices[1].GetStreamStatus(
+            pb.GetStreamStatusRequest(streamId=pb.StreamId(value=sid))
+        ).status
+        if status != pb.IN_PROGRESS:
+            break
+        time.sleep(0.02)
+    assert status == pb.FAILED
+    with pytest.raises(grpc.RpcError) as e:
+        client.read(1, 0x2000, 100)  # nothing was committed to the buffer
+    assert e.value.code() in (grpc.StatusCode.NOT_FOUND, grpc.StatusCode.OUT_OF_RANGE)
+
+
+def test_concurrent_all_reduces_on_one_comm_are_serialized(cluster):
+    """Race-detection stress (§5.2): many threads firing AllReduceRing at the
+    SAME communicator concurrently with the health prober running. Every call
+    must complete with a correct, consistent reduction — no torn buffers."""
+    import threading
+
+    client = _connect(cluster, n=4)
+    vals = [np.full(64, float(r + 1), np.float32) for r in range(4)]
+    expected = np.sum(vals, axis=0)
+    errors = []
+
+    def one_round(i):
+        try:
+            for r, v in enumerate(vals):
+                client.write(r, GRAD_ADDR, v)
+            client.all_reduce_ring(256)
+            got = bytes_to_f32(client.read(0, GRAD_ADDR, 256))
+            # the buffer holds either this round's reduction or another
+            # thread's (writes interleave), but never a torn mix
+            if not (np.allclose(got, expected) or any(np.allclose(got, v) for v in vals)):
+                errors.append((i, got[:4]))
+        except grpc.RpcError as e:  # pragma: no cover - failure is the signal
+            errors.append((i, str(e)))
+
+    threads = [threading.Thread(target=one_round, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert client.status() == pb.SUCCESS
+
+
 def test_naive_all_reduce_metrics_and_values(cluster):
     """Naive path: real reduction + the reference's latency accounting
     (gpu_coordinator_server.go:611-717)."""

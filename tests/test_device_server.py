@@ -83,6 +83,16 @@ def test_chunks_before_begin_receive_are_buffered(device):
     assert device.memcpy_d2h(0x2000, 4) == b"abcd"
 
 
+def test_late_arm_with_underdelivery_fails_immediately(device):
+    """Sender finished BEFORE BeginReceive arms, delivering fewer bytes than
+    the receiver then expects: the stream must go FAILED at arm time, not
+    hang IN_PROGRESS forever (the sender will never send more)."""
+    sid = 9
+    assert device.receive_chunks(iter([pb.DataChunk(data=b"abcd", streamId=sid)])) is True
+    device.begin_receive(sid, 0x2000, num_bytes=8, src_rank=1)  # expects 8, got 4
+    assert device.stream_status(sid) == pb.FAILED
+
+
 def test_unknown_stream_status_raises(device):
     with pytest.raises(DeviceError) as e:
         device.stream_status(424242)
