@@ -324,6 +324,7 @@ class DeviceRuntime:
                     st = self.streams[stream_id]
                     st.chunks.append(payload)
                     st.received += len(payload)
+                    st.sender_done = True  # a late mismatched arm must FAIL, not hang
                     self._maybe_complete_locked(st, final=True)
             else:
                 stub = self._peer_stub(dst_rank)

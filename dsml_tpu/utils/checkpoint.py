@@ -41,24 +41,30 @@ class Checkpointer:
             state["opt_state"] = opt_state
         if meta:
             state["meta"] = dict(meta)
-        self.manager.save(step, args=self._ocp.args.StandardSave(state))
+        # PyTreeSave (not StandardSave): the manager binds ONE handler per
+        # item name, and only the PyTree handler supports partial restore
+        self.manager.save(step, args=self._ocp.args.PyTreeSave(state))
         self.manager.wait_until_finished()
         log.info("saved checkpoint step %d -> %s", step, self.directory)
 
     def latest_step(self) -> int | None:
         return self.manager.latest_step()
 
-    def restore(self, step: int | None = None, template: Any = None) -> dict:
+    def restore(self, step: int | None = None, template: Any = None, partial: bool = False) -> dict:
         """Restore state. With ``template`` (a pytree of like-shaped arrays,
         e.g. freshly-initialized sharded params), arrays are restored with
-        the template's shardings/dtypes."""
+        the template's shardings/dtypes. ``partial=True`` restores only the
+        subtree named by the template (e.g. params without opt_state — the
+        inference-load path)."""
         step = step if step is not None else self.manager.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
         if template is not None:
             ref = jax.tree.map(self._ocp.utils.to_shape_dtype_struct, template)
-            return self.manager.restore(step, args=self._ocp.args.StandardRestore(ref))
-        return self.manager.restore(step)
+            return self.manager.restore(
+                step, args=self._ocp.args.PyTreeRestore(item=ref, partial_restore=partial)
+            )
+        return self.manager.restore(step, args=self._ocp.args.PyTreeRestore())
 
     def close(self) -> None:
         self.manager.close()

@@ -1,0 +1,87 @@
+"""Sample text from a (byte-tokenized) GPT-2 — the serving-side counterpart
+of examples/train_gpt2.py.
+
+Loads the latest checkpoint from ``--checkpoint_dir`` (as written by
+``train_gpt2.py --checkpoint_dir ...``) or falls back to fresh weights, runs
+the compiled prefill + KV-cache decode loop, and prints the continuations.
+The reference had no inference path at all (SURVEY.md: its only "model" ran
+forward on the client CPU during training).
+
+    python examples/train_gpt2.py --steps 300 --checkpoint_dir /tmp/gpt2_ckpt
+    python examples/generate_text.py --checkpoint_dir /tmp/gpt2_ckpt \
+        --prompt "the cat " --max_new_tokens 64 --temperature 0.8 --top_k 32
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")  # repo-root invocation
+
+from dsml_tpu.utils.config import Config, field
+
+
+@dataclasses.dataclass
+class GenerateConfig(Config):
+    platform: str = field("", help="jax platform override: cpu|tpu ('' = default)")
+    cpu_devices: int = field(0, help="virtual CPU device count for --platform cpu")
+    model: str = field("tiny", help="tiny | small — must match the trained model")
+    checkpoint_dir: str = field("", help="Orbax dir from train_gpt2 ('' = fresh weights)")
+    prompt: str = field("the cat ", help="prompt text (byte-tokenized)")
+    n_samples: int = field(2, help="continuations to sample")
+    max_new_tokens: int = field(64, help="tokens (bytes) to generate per sample")
+    temperature: float = field(0.8, help="0 = greedy")
+    top_k: int = field(32, help="0 = full distribution")
+    seed: int = field(0, help="sampling seed")
+
+
+def main(argv=None):
+    cfg = GenerateConfig.parse_args(argv)
+    from dsml_tpu.utils.platform import configure_platform
+
+    configure_platform(cfg.platform, cfg.cpu_devices)
+
+    import jax.numpy as jnp
+
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.utils.logging import get_logger
+
+    log = get_logger("generate")
+    model_cfg = GPT2Config.small() if cfg.model == "small" else GPT2Config.tiny(vocab_size=256)
+    model = GPT2(model_cfg)
+    params = model.init(0)
+    if cfg.checkpoint_dir:
+        from dsml_tpu.utils.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(cfg.checkpoint_dir)
+        params = ckpt.restore(template={"params": params}, partial=True)["params"]
+        ckpt.close()
+        log.info("loaded checkpoint from %s", cfg.checkpoint_dir)
+
+    if not cfg.prompt:
+        raise SystemExit("--prompt must be non-empty")
+    prompt_bytes = np.frombuffer(cfg.prompt.encode(), np.uint8).astype(np.int32)
+    prompt_bytes = prompt_bytes % model_cfg.vocab_size
+    prompt = jnp.asarray(np.tile(prompt_bytes, (cfg.n_samples, 1)))
+
+    out = model.generate(
+        params,
+        prompt,
+        max_new_tokens=cfg.max_new_tokens,
+        temperature=cfg.temperature,
+        top_k=cfg.top_k,
+        seed=cfg.seed,
+    )
+    texts = []
+    for row in np.asarray(out):
+        text = bytes(int(t) % 256 for t in row).decode("utf-8", errors="replace")
+        texts.append(text)
+        print(f"{cfg.prompt!r} -> {text!r}")
+    return texts
+
+
+if __name__ == "__main__":
+    main()

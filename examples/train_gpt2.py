@@ -55,6 +55,7 @@ class GPT2TrainConfig(Config):
     seed: int = field(0, help="init/data seed")
     log_every: int = field(10, help="log every N steps")
     profile_dir: str = field("", help="write a jax.profiler (TensorBoard) trace of the run here")
+    checkpoint_dir: str = field("", help="Orbax checkpoint directory; saves params+opt_state at the end ('' = off), resumes when one exists")
 
 
 _WORDS = {
@@ -147,12 +148,29 @@ def main(argv=None):
         y = np.stack([tokens[s + 1 : s + seq + 1] for s in starts])
         return x, y
 
-    optimizer = optax.adamw(make_schedule("cosine", cfg.lr, cfg.steps, cfg.warmup_steps))
+    # probe the checkpoint FIRST: a resumed optimizer count sits at
+    # start_step, so the cosine horizon must cover start_step + cfg.steps or
+    # every resumed update would land past decay-end at lr = 0
+    ckpt = None
+    start_step = 0
+    if cfg.checkpoint_dir:
+        from dsml_tpu.utils.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(cfg.checkpoint_dir)
+        start_step = ckpt.latest_step() or 0
+
+    optimizer = optax.adamw(
+        make_schedule("cosine", cfg.lr, start_step + cfg.steps, cfg.warmup_steps)
+    )
     step = make_hybrid_train_step(
         model, optimizer, mesh, attn_impl=cfg.attn, grad_accum=cfg.grad_accum,
         n_microbatches=n_micro,
     )
     params, opt_state = init_hybrid(model, optimizer, mesh, seed=cfg.seed)
+    if ckpt is not None and start_step > 0:
+        state = ckpt.restore(template={"params": params, "opt_state": opt_state})
+        params, opt_state = state["params"], state["opt_state"]
+        log.info("resumed from checkpoint at step %d", start_step)
     n_params = model.n_params(params)
     log.info(
         "GPT-2 %s: %.1fM params, mesh pp=%d dp=%d sp=%d tp=%d, seq=%d, batch=%d x accum=%d",
@@ -163,7 +181,9 @@ def main(argv=None):
 
     from dsml_tpu.utils.tracing import trace
 
-    rng = np.random.default_rng(cfg.seed)
+    # advance the data stream past what the first run consumed, like the
+    # Trainer's per-epoch cfg.seed + epoch
+    rng = np.random.default_rng(cfg.seed + start_step)
     t0 = time.monotonic()
     tokens_done = 0
     first_loss = None
@@ -179,6 +199,9 @@ def main(argv=None):
                 loss_f = float(loss)
                 tps = tokens_done / max(time.monotonic() - t0, 1e-9)
                 log.info("step %d: loss = %.4f, %.0f tokens/s", i, loss_f, tps)
+    if ckpt is not None:
+        ckpt.save(start_step + cfg.steps, params, opt_state)
+        ckpt.close()
     return {"first_loss": first_loss, "last_loss": float(loss)}
 
 

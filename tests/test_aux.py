@@ -137,3 +137,19 @@ def test_prefetch_batches_preserves_order_and_errors():
 
     with pytest.raises(RuntimeError, match="loader died"):
         list(it)
+
+
+def test_checkpoint_partial_restore_params_only(tmp_path):
+    """Inference loaders restore params without the opt_state subtree."""
+    import jax.numpy as jnp
+
+    from dsml_tpu.utils.checkpoint import Checkpointer
+
+    params = {"w": jnp.arange(8.0), "b": jnp.ones(3)}
+    opt_state = {"momentum": jnp.zeros(8)}
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    ckpt.save(5, params, opt_state)
+    got = ckpt.restore(template={"params": params}, partial=True)
+    np.testing.assert_array_equal(np.asarray(got["params"]["w"]), np.arange(8.0))
+    assert "opt_state" not in got
+    ckpt.close()

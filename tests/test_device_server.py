@@ -83,6 +83,25 @@ def test_chunks_before_begin_receive_are_buffered(device):
     assert device.memcpy_d2h(0x2000, 4) == b"abcd"
 
 
+def test_self_send_late_arm_underdelivery_fails(device):
+    """Same late-arm hang guard for the LOCAL delivery path (rank → itself):
+    the background push finishes before BeginReceive; a mismatched arm must
+    go FAILED, not IN_PROGRESS forever."""
+    import time as _t
+
+    device.configure_peers({0: "local"}, self_rank=0)
+    device.memcpy_h2d(0x1000, b"abcd")
+    sid = device.begin_send(0x1000, 4, dst_rank=0)
+    deadline = _t.monotonic() + 5  # wait for the background push to land
+    while _t.monotonic() < deadline:
+        with device._stream_lock:
+            if device.streams[sid].sender_done:
+                break
+        _t.sleep(0.01)
+    device.begin_receive(sid, 0x2000, num_bytes=8, src_rank=0)  # expects 8, got 4
+    assert device.stream_status(sid) == pb.FAILED
+
+
 def test_late_arm_with_underdelivery_fails_immediately(device):
     """Sender finished BEFORE BeginReceive arms, delivering fewer bytes than
     the receiver then expects: the stream must go FAILED at arm time, not
