@@ -244,9 +244,6 @@ class GPT2:
         def block(layer, x):
             return self._block(layer, x, n_head_local, tp_axis, sp_axis, attn_impl)
 
-        if cfg.remat:
-            block = jax.checkpoint(block)
-
         if pp_axis:
             from dsml_tpu.parallel.pp import pipeline_apply
 
@@ -254,9 +251,14 @@ class GPT2:
             if b % n_micro:
                 raise ValueError(f"per-rank batch {b} not divisible by n_micro={n_micro}")
             micro = h.reshape(n_micro, b // n_micro, *h.shape[1:])
-            outs = pipeline_apply(block, params["layers"], micro, pp_axis)
+            # remat at STAGE granularity (one checkpoint per tick) rather
+            # than per block — the coarser cut bounds in-flight activations
+            # the way 1F1B does
+            outs = pipeline_apply(block, params["layers"], micro, pp_axis, remat=cfg.remat)
             h = outs.reshape(b, *h.shape[1:])
         else:
+            if cfg.remat:
+                block = jax.checkpoint(block)
             for layer in params["layers"]:
                 h = block(layer, h)
 

@@ -44,6 +44,7 @@ def pipeline_apply(
     stage_params,
     microbatches: jax.Array,
     axis: str = "pp",
+    remat: bool = False,
 ) -> jax.Array:
     """Run a layer stack as a pipeline. Call under ``shard_map``.
 
@@ -52,6 +53,11 @@ def pipeline_apply(
     ``stage_params`` — this rank's layers, leading axis = layers-per-stage.
     ``microbatches`` — [M, microbatch, ...], replicated across the axis
     (only stage 0 consumes them).
+    ``remat=True`` rematerializes each tick's stage computation in the
+    backward pass: activation memory stops scaling with the number of
+    microbatches in flight — the memory property 1F1B scheduling
+    (PipeDream, SURVEY.md §2.3) buys, achieved compiler-side instead of by
+    hand-interleaving forward/backward.
 
     Returns [M, microbatch, ...] outputs, replicated to every rank.
     """
@@ -65,6 +71,9 @@ def pipeline_apply(
 
         out, _ = lax.scan(body, x, stage_params)
         return out
+
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
 
     if n_stage == 1:
         return jax.vmap(stage_fn)(microbatches)

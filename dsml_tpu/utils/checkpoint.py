@@ -61,8 +61,17 @@ class Checkpointer:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
         if template is not None:
             ref = jax.tree.map(self._ocp.utils.to_shape_dtype_struct, template)
+            # restore_args carry the template's dtypes AND shardings — plain
+            # PyTreeRestore(item=...) would return the dtypes/placements the
+            # checkpoint was written with (breaking e.g. a bf16-trained
+            # checkpoint loaded into an f32 inference model, or a restore
+            # onto a different mesh)
+            restore_args = self._ocp.checkpoint_utils.construct_restore_args(template)
             return self.manager.restore(
-                step, args=self._ocp.args.PyTreeRestore(item=ref, partial_restore=partial)
+                step,
+                args=self._ocp.args.PyTreeRestore(
+                    item=ref, restore_args=restore_args, partial_restore=partial
+                ),
             )
         return self.manager.restore(step, args=self._ocp.args.PyTreeRestore())
 

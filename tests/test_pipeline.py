@@ -88,6 +88,32 @@ def test_pipeline_gradients_match_sequential(pp_mesh):
     np.testing.assert_allclose(np.asarray(g_pp["b"]), np.asarray(g_seq["b"]), rtol=1e-4, atol=1e-5)
 
 
+def test_pipeline_remat_gradients_identical(pp_mesh):
+    """Stage-level remat (jax.checkpoint over each tick) must not change
+    gradients — memory-only, like per-block remat."""
+    layers = _layers(6)
+    xs = jnp.asarray(np.random.default_rng(7).standard_normal((4, MB, WIDTH)), jnp.float32)
+    stacked = stack_layer_params(layers)
+
+    def loss_with(remat):
+        def fn(stacked, xs):
+            wrapped = jax.shard_map(
+                lambda p, x: pipeline_apply(_layer_fn, p, x, "pp", remat=remat),
+                mesh=pp_mesh,
+                in_specs=(pipeline_specs(LAYER_SPEC), P(None, "dp")),
+                out_specs=P(None, "dp"),
+                check_vma=False,
+            )
+            return jnp.sum(wrapped(stacked, xs) ** 2)
+
+        return fn
+
+    g0 = jax.jit(jax.grad(loss_with(False)))(stacked, xs)
+    g1 = jax.jit(jax.grad(loss_with(True)))(stacked, xs)
+    np.testing.assert_allclose(np.asarray(g1["w"]), np.asarray(g0["w"]), rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(g1["b"]), np.asarray(g0["b"]), rtol=1e-6, atol=1e-7)
+
+
 def test_single_stage_degenerates_to_sequential(devices8):
     mesh = build_mesh(MeshSpec(pp=1, dp=8), devices8)
     layers = _layers(4)
