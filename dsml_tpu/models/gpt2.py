@@ -507,11 +507,13 @@ class GPT2:
         max_new_tokens: int,
         temperature: float = 0.0,
         top_k: int = 0,
+        top_p: float = 0.0,
         seed: int = 0,
     ) -> jax.Array:
         """Sample ``max_new_tokens`` continuations. ``temperature == 0`` is
         greedy; otherwise softmax sampling, optionally truncated to the
-        ``top_k`` most likely tokens. Returns [batch, max_new_tokens]."""
+        ``top_k`` most likely tokens and/or the nucleus holding ``top_p``
+        probability mass. Returns [batch, max_new_tokens]."""
         cfg = self.config
         b, t = prompt.shape
         if max_new_tokens < 1:
@@ -522,15 +524,19 @@ class GPT2:
             )
         if top_k < 0 or top_k > cfg.vocab_size:
             raise ValueError(f"top_k must be in [0, vocab_size={cfg.vocab_size}], got {top_k}")
+        if not 0.0 <= top_p <= 1.0:
+            raise ValueError(f"top_p must be in [0, 1], got {top_p}")
         if temperature < 0.0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
-        run = self._generate_fn(t, max_new_tokens, float(temperature), int(top_k))
+        run = self._generate_fn(t, max_new_tokens, float(temperature), int(top_k), float(top_p))
         return run(params, prompt.astype(jnp.int32), jax.random.PRNGKey(seed))
 
-    def _generate_fn(self, prompt_len: int, max_new_tokens: int, temperature: float, top_k: int):
+    def _generate_fn(
+        self, prompt_len: int, max_new_tokens: int, temperature: float, top_k: int, top_p: float = 0.0
+    ):
         """Compiled generate program, cached per (prompt_len, max_new,
-        temperature, top_k) so repeated serving calls don't re-trace."""
-        key_ = (prompt_len, max_new_tokens, temperature, top_k)
+        temperature, top_k, top_p) so repeated serving calls don't re-trace."""
+        key_ = (prompt_len, max_new_tokens, temperature, top_k, top_p)
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
             cache = self._gen_cache = {}
@@ -544,6 +550,18 @@ class GPT2:
             if top_k > 0:
                 kth = lax.top_k(logits, top_k)[0][..., -1:]
                 logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if top_p > 0.0:
+                # nucleus: keep the smallest prefix (by descending prob)
+                # whose mass reaches top_p; always keep the argmax
+                sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+                probs = jax.nn.softmax(sorted_logits, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                # cutoff logit: last sorted position with cum - p < top_p
+                keep = (cum - probs) < top_p  # mass BEFORE this token < p
+                cutoff = jnp.min(
+                    jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+                )
+                logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
             return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
         @jax.jit

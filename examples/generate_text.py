@@ -35,6 +35,7 @@ class GenerateConfig(Config):
     max_new_tokens: int = field(64, help="tokens (bytes) to generate per sample")
     temperature: float = field(0.8, help="0 = greedy")
     top_k: int = field(32, help="0 = full distribution")
+    top_p: float = field(0.0, help="nucleus sampling mass (0 = off)")
     seed: int = field(0, help="sampling seed")
 
 
@@ -73,6 +74,7 @@ def main(argv=None):
         max_new_tokens=cfg.max_new_tokens,
         temperature=cfg.temperature,
         top_k=cfg.top_k,
+        top_p=cfg.top_p,
         seed=cfg.seed,
     )
     texts = []

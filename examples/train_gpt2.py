@@ -54,6 +54,7 @@ class GPT2TrainConfig(Config):
     warmup_steps: int = field(10, help="linear warmup steps")
     seed: int = field(0, help="init/data seed")
     log_every: int = field(10, help="log every N steps")
+    eval_every: int = field(0, help="held-out perplexity every N steps (0 = off)")
     profile_dir: str = field("", help="write a jax.profiler (TensorBoard) trace of the run here")
     checkpoint_dir: str = field("", help="Orbax checkpoint directory; saves params+opt_state at the end ('' = off), resumes when one exists")
 
@@ -141,12 +142,27 @@ def main(argv=None):
         corpus = _generated_stories(max(need, 1 << 20), cfg.seed)
         log.info("no --data file; generated %d bytes of story corpus", len(corpus))
     tokens = np.frombuffer(corpus, np.uint8).astype(np.int32) % model_cfg.vocab_size
+    eval_tokens = None
+    if cfg.eval_every:
+        carve = max((seq + 1) * cfg.batch_size, len(tokens) // 20)
+        if carve > len(tokens) // 4 or len(tokens) - carve <= seq + 1:
+            log.warning(
+                "corpus (%d tokens) too small to carve a %d-token eval split at "
+                "seq=%d; eval disabled, training keeps the full corpus",
+                len(tokens), carve, seq,
+            )
+        else:
+            split = len(tokens) - carve
+            tokens, eval_tokens = tokens[:split], tokens[split:]
+
+    def sample_from(pool, rng):
+        starts = rng.integers(0, len(pool) - seq - 1, size=cfg.batch_size)
+        x = np.stack([pool[s : s + seq] for s in starts])
+        y = np.stack([pool[s + 1 : s + seq + 1] for s in starts])
+        return x, y
 
     def sample_batch(rng):
-        starts = rng.integers(0, len(tokens) - seq - 1, size=cfg.batch_size)
-        x = np.stack([tokens[s : s + seq] for s in starts])
-        y = np.stack([tokens[s + 1 : s + seq + 1] for s in starts])
-        return x, y
+        return sample_from(tokens, rng)
 
     # probe the checkpoint FIRST: a resumed optimizer count sits at
     # start_step, so the cosine horizon must cover start_step + cfg.steps or
@@ -181,6 +197,26 @@ def main(argv=None):
 
     from dsml_tpu.utils.tracing import trace
 
+    eval_loss_fn = None
+    if eval_tokens is not None:
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from dsml_tpu.parallel.hybrid import hybrid_loss_fn
+
+        _lf = hybrid_loss_fn(model, cfg.attn, "pp" if cfg.pp > 1 else None, n_micro)
+        eval_loss_fn = jax.jit(
+            jax.shard_map(
+                lambda p, x, y: lax.pmean(_lf(p, x, y), ("dp", "sp")),
+                mesh=mesh,
+                in_specs=(model.param_specs(pp=cfg.pp > 1), P("dp", "sp"), P("dp", "sp")),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+        # one fixed held-out batch, built once (it's deterministic anyway)
+        eval_x, eval_y = sample_from(eval_tokens, np.random.default_rng(1234))
+
     # advance the data stream past what the first run consumed, like the
     # Trainer's per-epoch cfg.seed + epoch
     rng = np.random.default_rng(cfg.seed + start_step)
@@ -199,6 +235,9 @@ def main(argv=None):
                 loss_f = float(loss)
                 tps = tokens_done / max(time.monotonic() - t0, 1e-9)
                 log.info("step %d: loss = %.4f, %.0f tokens/s", i, loss_f, tps)
+            if eval_loss_fn is not None and (i % cfg.eval_every == 0 or i == cfg.steps):
+                el = float(eval_loss_fn(params, eval_x, eval_y))
+                log.info("step %d: eval loss = %.4f, perplexity = %.2f", i, el, float(np.exp(el)))
     if ckpt is not None:
         ckpt.save(start_step + cfg.steps, params, opt_state)
         ckpt.close()

@@ -67,6 +67,25 @@ def test_sampled_generation_in_vocab_range(model_and_params):
     assert not np.array_equal(o, np.asarray(out2))
 
 
+def test_top_p_sampling_stays_in_nucleus(model_and_params):
+    """With a tiny top_p, sampling must collapse to (near-)greedy: every
+    sampled token is the argmax when one token holds > top_p of the mass."""
+    model, params = model_and_params
+    prompt = jnp.asarray([[9, 10, 11]], jnp.int32)
+    greedy = np.asarray(model.generate(params, prompt, max_new_tokens=6))
+    nucleus = np.asarray(
+        model.generate(params, prompt, max_new_tokens=6, temperature=0.5, top_p=1e-6, seed=5)
+    )
+    np.testing.assert_array_equal(nucleus, greedy)
+    # sane range with a realistic nucleus
+    out = np.asarray(
+        model.generate(params, prompt, max_new_tokens=6, temperature=0.9, top_p=0.9, seed=6)
+    )
+    assert (out >= 0).all() and (out < 512).all()
+    with pytest.raises(ValueError, match="top_p"):
+        model.generate(params, prompt, max_new_tokens=2, temperature=0.5, top_p=1.5)
+
+
 def test_generate_rejects_overflow(model_and_params):
     model, params = model_and_params
     prompt = jnp.zeros((1, 120), jnp.int32)
