@@ -56,6 +56,10 @@ class GPT2Config:
     # pass instead of storing them — trades FLOPs for HBM (the memory-
     # efficiency capability of the reference's §7 literature, ActNN/GACT)
     remat: bool = False
+    # unsharded-vocab losses stream the unembedding in chunks of this many
+    # rows (ops/xent.py) instead of materializing [tokens, vocab] logits;
+    # only kicks in when vocab_size > xent_chunk (0 disables)
+    xent_chunk: int = 8192
 
     @staticmethod
     def small() -> "GPT2Config":
@@ -216,6 +220,15 @@ class GPT2:
         stage→stage over ``ppermute``, and the returned logits are replicated
         across pp ranks.
         """
+        h = self._hidden_spmd(params, tokens, tp_axis, sp_axis, attn_impl, seq_offset, pp_axis, n_micro)
+        return h @ params["wte"].T  # tied unembedding → [b, s, vocab/tp]
+
+    def _hidden_spmd(
+        self, params, tokens, tp_axis=None, sp_axis=None, attn_impl="ring",
+        seq_offset=None, pp_axis=None, n_micro=1,
+    ):
+        """Forward to the final-layer-norm hidden states [b, s, d] (shared by
+        the logits head and the chunked-xent loss that never builds logits)."""
         cfg = self.config
         tp_size = lax.axis_size(tp_axis) if tp_axis else 1
         if cfg.n_head % tp_size:
@@ -262,8 +275,7 @@ class GPT2:
             for layer in params["layers"]:
                 h = block(layer, h)
 
-        h = _layer_norm(h, **params["ln_f"])
-        return h @ params["wte"].T  # tied unembedding → [b, s, vocab/tp]
+        return _layer_norm(h, **params["ln_f"])
 
     def _block(self, layer, h, n_head_local, tp_axis, sp_axis, attn_impl):
         """One transformer block (pre-LN attention + MLP/MoE residuals) —
@@ -379,9 +391,7 @@ class GPT2:
         embedding's on rank 0 via the pipeline feed mask), letting the caller
         reconstruct full non-layer grads with one psum over pp
         (``parallel.hybrid``)."""
-        logits = self.apply_spmd(
-            params, tokens, tp_axis, sp_axis, attn_impl, pp_axis=pp_axis, n_micro=n_micro
-        ).astype(jnp.float32)
+        cfg = self.config
 
         def finalize(loss):
             if pp_axis:
@@ -389,10 +399,28 @@ class GPT2:
                 loss = lax.psum(jnp.where(is_last, loss, 0.0), pp_axis)
             return loss
 
-        if not tp_axis:
+        # tp of size 1 (the hybrid step always has a tp axis, often unit —
+        # e.g. GPT-2-small pure-DP) is an UNsharded vocab: route it to the
+        # chunked/dense single-shard path, not the TP logits path
+        tp_size = lax.axis_size(tp_axis) if tp_axis else 1
+        if tp_size == 1:
+            h = self._hidden_spmd(
+                params, tokens, tp_axis, sp_axis, attn_impl, pp_axis=pp_axis, n_micro=n_micro
+            )
+            if cfg.xent_chunk and cfg.vocab_size > cfg.xent_chunk:
+                # big unsharded vocab: stream the unembedding — [tokens,
+                # vocab] logits never exist (ops/xent.py)
+                from dsml_tpu.ops.xent import chunked_softmax_xent
+
+                return finalize(chunked_softmax_xent(h, params["wte"], targets, cfg.xent_chunk))
+            logits = (h @ params["wte"].T).astype(jnp.float32)
             logp = jax.nn.log_softmax(logits)
             nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
             return finalize(nll.mean())
+
+        logits = self.apply_spmd(
+            params, tokens, tp_axis, sp_axis, attn_impl, pp_axis=pp_axis, n_micro=n_micro
+        ).astype(jnp.float32)
         vocab_shard = logits.shape[-1]
         tp_rank = lax.axis_index(tp_axis)
         # distributed logsumexp (max-shift carries no gradient, and pmax has

@@ -1,0 +1,131 @@
+"""Chunked softmax cross-entropy — full logits never materialize.
+
+For a tied-embedding LM the loss ``mean(logsumexp(h·Wᵀ) − h·W[target])``
+normally materializes [batch·seq, vocab] float32 logits (GPT-2-small at
+batch 8 × seq 1024 × vocab 50257 is ~1.6 GB — often the single largest
+tensor of the step). This computes the same value by scanning the vocab in
+chunks with an online logsumexp, so peak memory is [N, chunk]:
+
+- forward: running (row-max, sum-exp) across chunks + the target logit
+  (each target row lives in exactly one chunk);
+- backward (custom VJP): per chunk, recompute ``p = exp(h·Wcᵀ − lse)``,
+  subtract the one-hot target, and accumulate ``dh += p·Wc`` and
+  ``dWc = pᵀ·h`` — the textbook softmax-CE gradient, chunk by chunk.
+
+This is the single-shard counterpart of the TP path's distributed-logsumexp
+loss (``models/gpt2.py::loss_spmd``), which splits vocab across chips
+instead of across time. Used automatically by GPT-2 when the vocab is
+unsharded and large.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["chunked_softmax_xent"]
+
+
+def _pad_vocab(wte: jax.Array, chunk: int):
+    v = wte.shape[0]
+    n_chunks = -(-v // chunk)
+    padded = n_chunks * chunk
+    if padded != v:
+        wte = jnp.pad(wte, ((0, padded - v), (0, 0)))
+    return wte, n_chunks, v
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _chunked_xent(h: jax.Array, wte: jax.Array, targets: jax.Array, chunk: int):
+    """Per-row loss ``lse − tgt_logit``. h [N, d] (any float dtype — promoted
+    to f32 for the reductions), wte [V, d], targets [N] int32 → [N] f32."""
+    loss, _ = _forward(h, wte, targets, chunk)
+    return loss
+
+
+def _forward(h, wte, targets, chunk):
+    n = h.shape[0]
+    h32 = h.astype(jnp.float32)
+    wte_p, n_chunks, v = _pad_vocab(wte, chunk)
+    # keep the scanned weights in their stored dtype; cast per chunk inside
+    # the body so only [chunk, d] ever exists in f32 (a whole-vocab f32 copy
+    # would cost more than the logits this module avoids)
+    w_chunks = wte_p.reshape(n_chunks, chunk, -1)
+
+    def body(carry, inputs):
+        m, s, tgt = carry
+        w_c, c_idx = inputs
+        logits = h32 @ w_c.astype(jnp.float32).T  # [N, chunk]
+        col = c_idx * chunk + jnp.arange(chunk)
+        logits = jnp.where(col[None, :] < v, logits, -jnp.inf)  # mask vocab padding
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(jnp.exp(logits - m_new[:, None]), axis=-1)
+        local = targets - c_idx * chunk
+        in_c = (local >= 0) & (local < chunk)
+        safe = jnp.clip(local, 0, chunk - 1)
+        tgt = tgt + jnp.where(in_c, jnp.take_along_axis(logits, safe[:, None], 1)[:, 0], 0.0)
+        return (m_new, s, tgt), None
+
+    m0 = jnp.full((n,), -jnp.inf, jnp.float32)
+    s0 = jnp.zeros((n,), jnp.float32)
+    t0 = jnp.zeros((n,), jnp.float32)
+    (m, s, tgt), _ = lax.scan(body, (m0, s0, t0), (w_chunks, jnp.arange(n_chunks)))
+    lse = m + jnp.log(s)
+    return lse - tgt, lse
+
+
+def _fwd_rule(h, wte, targets, chunk):
+    loss, lse = _forward(h, wte, targets, chunk)
+    return loss, (h, wte, targets, lse)
+
+
+def _bwd_rule(chunk, res, g):  # g: [N] cotangent of the per-row loss
+    h, wte, targets, lse = res
+    h32 = h.astype(jnp.float32)
+    wte_p, n_chunks, v = _pad_vocab(wte, chunk)
+    w_chunks = wte_p.reshape(n_chunks, chunk, -1)  # stored dtype; cast per chunk
+    g32 = g.astype(jnp.float32)
+
+    def body(dh, inputs):
+        w_c, c_idx = inputs
+        w_c32 = w_c.astype(jnp.float32)
+        logits = h32 @ w_c32.T
+        col = c_idx * chunk + jnp.arange(chunk)
+        logits = jnp.where(col[None, :] < v, logits, -jnp.inf)
+        p = jnp.exp(logits - lse[:, None])  # softmax rows for this chunk
+        local = targets - c_idx * chunk
+        in_c = (local >= 0) & (local < chunk)
+        onehot = (col[None, :] == targets[:, None]) & in_c[:, None]
+        ds = (p - onehot.astype(jnp.float32)) * g32[:, None]  # [N, chunk]
+        dh = dh + ds @ w_c32
+        dw_c = ds.T @ h32  # [chunk, d]
+        return dh, dw_c
+
+    dh0 = jnp.zeros_like(h32)
+    dh, dw_chunks = lax.scan(body, dh0, (w_chunks, jnp.arange(n_chunks)))
+    dwte = dw_chunks.reshape(n_chunks * chunk, -1)[:v]
+    return dh.astype(h.dtype), dwte.astype(wte.dtype), None
+
+
+_chunked_xent.defvjp(_fwd_rule, _bwd_rule)
+
+
+def chunked_softmax_xent(
+    h: jax.Array,  # [..., d] final hidden states
+    wte: jax.Array,  # [V, d] (tied) unembedding matrix
+    targets: jax.Array,  # [...] int32
+    chunk: int = 8192,
+) -> jax.Array:
+    """Mean next-token cross-entropy of ``h @ wte.T`` vs ``targets`` without
+    ever materializing the logits. Differentiable in h and wte."""
+    d = h.shape[-1]
+    n_rows = 1
+    for s in h.shape[:-1]:
+        n_rows *= s
+    loss_vec = _chunked_xent(
+        h.reshape(n_rows, d), wte, targets.reshape(n_rows).astype(jnp.int32), int(chunk)
+    )
+    return loss_vec.mean()

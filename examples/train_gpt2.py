@@ -144,7 +144,7 @@ def main(argv=None):
     tokens = np.frombuffer(corpus, np.uint8).astype(np.int32) % model_cfg.vocab_size
     eval_tokens = None
     if cfg.eval_every:
-        carve = max((seq + 1) * cfg.batch_size, len(tokens) // 20)
+        carve = max((seq + 1) * cfg.batch_size, len(tokens) // 20, seq + 2)
         if carve > len(tokens) // 4 or len(tokens) - carve <= seq + 1:
             log.warning(
                 "corpus (%d tokens) too small to carve a %d-token eval split at "
