@@ -296,9 +296,14 @@ class GPT2:
             # silently-wrong block-diagonal attention — route it to ring.
             if attn_impl == "ulysses":
                 out = ulysses_attention(q, k, v, sp_axis, causal=True)
+            elif attn_impl == "ring_flash":
+                from dsml_tpu.ops.flash import ring_flash_attention
+
+                out = ring_flash_attention(q, k, v, sp_axis, causal=True)
             else:
                 out = ring_attention(q, k, v, sp_axis, causal=True)
-        elif attn_impl == "flash":
+        elif attn_impl in ("flash", "ring_flash"):
+            # no sp axis → ring_flash degenerates to the single-chip kernel
             from dsml_tpu.ops.flash import flash_attention
 
             out = flash_attention(q, k, v, causal=True)

@@ -9,4 +9,8 @@ from dsml_tpu.ops.collectives import (  # noqa: F401
     reduce_scatter,
     ring_all_reduce,
 )
-from dsml_tpu.ops.flash import flash_attention  # noqa: F401
+from dsml_tpu.ops.flash import (  # noqa: F401
+    flash_attention,
+    flash_attention_lse,
+    ring_flash_attention,
+)

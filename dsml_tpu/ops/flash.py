@@ -1,28 +1,36 @@
-"""Flash attention as a Pallas TPU kernel — the framework's hot-op kernel.
+"""Flash attention as Pallas TPU kernels — the framework's hot-op kernels.
 
 The reference has no on-device compute at all (its "GPUs" stream bytes,
 ``DSML/gpu_device_service/gpu_device_server.go:26-49``); its intended compute
 API (vestigial ``RunForward``/``RunBackward`` RPCs, SURVEY.md §8.9) is
 realized in this framework as jitted XLA graphs — and, for the attention hot
-op, as a hand-written Pallas kernel so the [seq, seq] score matrix never
+op, as hand-written Pallas kernels so the [seq, seq] score matrix never
 touches HBM:
 
 - forward: blockwise q·kᵀ on the MXU with online-softmax accumulators
   (running row-max, running denominator) held in VMEM scratch across the
-  innermost kv-block grid dimension;
+  innermost kv-block grid dimension; emits the per-row logsumexp.
 - backward: the standard two-kernel flash split — one pass accumulates dq
   over kv blocks, a second accumulates dk/dv over q blocks — recomputing
   p = exp(s − L) from the forward's saved logsumexp rather than storing
-  probabilities.
+  probabilities. The logsumexp output is differentiable too (its cotangent
+  folds into ds as ``p · g_lse``), which is what lets whole flash calls be
+  COMBINED downstream.
+- :func:`ring_flash_attention` — sequence-parallel attention where every
+  ring hop is one flash call: q/k blocks carry their global position
+  offsets (SMEM scalars, so the causal mask is correct for any hop pair),
+  K/V rotate via ``ppermute``, and the per-hop (out, lse) pairs merge with
+  logsumexp weights. Exact full attention at O(block²) VMEM per chip —
+  Ring Self-Attention (SURVEY.md §5.7) with a flash inner loop.
 
 Causal blocks entirely above the diagonal are skipped via ``pl.when``
-predication. On non-TPU backends the same kernels run under the Pallas
-interpreter (``interpret=True``), which is how tests/test_flash.py validates
-them on the CI CPU mesh; on TPU they compile through Mosaic.
+predication (a dynamic predicate when offsets are traced). On non-TPU
+backends the same kernels run under the Pallas interpreter
+(``interpret=True``), which is how tests validate them on the CI CPU mesh;
+on TPU they compile through Mosaic.
 
-Used by ``dsml_tpu.models.gpt2`` via ``attn_impl="flash"``; composes with
-tensor parallelism (heads are already TP-sharded when this runs under
-``shard_map``).
+Used by ``dsml_tpu.models.gpt2`` via ``attn_impl="flash"`` (single-chip) and
+``attn_impl="ring_flash"`` (sequence-parallel).
 """
 
 from __future__ import annotations
@@ -31,6 +39,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 
 try:  # pltpu is importable on CPU builds too; guard anyway
@@ -38,7 +47,7 @@ try:  # pltpu is importable on CPU builds too; guard anyway
 except ImportError:  # pragma: no cover
     pltpu = None
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_lse", "ring_flash_attention"]
 
 _NEG_INF = -1e30
 _MAX_FLOOR = -1e20  # running-max floor: keeps exp() sane for fully-masked rows
@@ -54,6 +63,18 @@ def _vmem_spec(block_shape, index_map):
     return pl.BlockSpec(block_shape, index_map)
 
 
+def _smem_spec():
+    if pltpu is not None:
+        return pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.BlockSpec()  # pragma: no cover
+
+
+def _scratch(shape):
+    if pltpu is not None:
+        return pltpu.VMEM(shape, jnp.float32)
+    return pl.MemoryRef(shape, jnp.float32)  # pragma: no cover
+
+
 def _pick_block(seq: int, preferred: int) -> int | None:
     for b in (preferred, 128, 64, 32, 16, 8):
         if b <= preferred and seq % b == 0:
@@ -61,14 +82,22 @@ def _pick_block(seq: int, preferred: int) -> int | None:
     return None
 
 
+def _positions(qs, ks, qi, ki, block_q, block_k):
+    """Global (row, col) position grids for the current (q, kv) block pair."""
+    q_pos = qs + qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = ks + ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return q_pos, k_pos
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale, causal, block_q, block_k, kv_blocks):
+def _fwd_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale, causal, block_q, block_k, kv_blocks):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    qs, ks = qs_ref[0], ks_ref[0]
 
     @pl.when(ki == 0)
     def _init():
@@ -84,8 +113,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            q_pos, k_pos = _positions(qs, ks, qi, ki, block_q, block_k)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -98,8 +126,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
     if causal:
-        # blocks strictly above the diagonal contribute nothing
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        # blocks with every column strictly in the future contribute nothing
+        # (dynamic predicate: offsets are traced values)
+        @pl.when(ks + ki * block_k <= qs + qi * block_q + block_q - 1)
         def _():
             compute()
     else:
@@ -114,7 +143,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale
         lse_ref[0] = jnp.broadcast_to((m_scr[:, :1] + jnp.log(l_fin)).reshape(1, block_q), (8, block_q))
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, q_start, k_start, causal, block_q, block_k, interpret):
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
     scale = d**-0.5
@@ -128,6 +157,8 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         kernel,
         grid=(bh, q_blocks, kv_blocks),
         in_specs=[
+            _smem_spec(),
+            _smem_spec(),
             _vmem_spec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
             _vmem_spec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             _vmem_spec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
@@ -146,14 +177,12 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
             _scratch((block_q, 128)),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(_scalar(q_start), _scalar(k_start), q, k, v)
     return out, lse
 
 
-def _scratch(shape):
-    if pltpu is not None:
-        return pltpu.VMEM(shape, jnp.float32)
-    return pl.MemoryRef(shape, jnp.float32)  # pragma: no cover
+def _scalar(x):
+    return jnp.atleast_1d(jnp.asarray(x, jnp.int32))
 
 
 # ---------------------------------------------------------------------------
@@ -161,9 +190,10 @@ def _scratch(shape):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc, *, scale, causal, block_q, block_k, kv_blocks):
+def _dq_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref, dq_ref, acc, *, scale, causal, block_q, block_k, kv_blocks):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    qs, ks = qs_ref[0], ks_ref[0]
 
     @pl.when(ki == 0)
     def _init():
@@ -176,22 +206,22 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc, *, 
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, 0].reshape(block_q, 1)
         delta = delta_ref[0, 0].reshape(block_q, 1)
+        glse = glse_ref[0, 0].reshape(block_q, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            q_pos, k_pos = _positions(qs, ks, qi, ki, block_q, block_k)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = p * (dp - delta + glse)  # glse: cotangent of the lse output
         acc[:] = acc[:] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
     if causal:
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        @pl.when(ks + ki * block_k <= qs + qi * block_q + block_q - 1)
         def _():
             compute()
     else:
@@ -202,9 +232,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc, *, 
         dq_ref[0] = (acc[:] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, block_q, block_k, q_blocks):
+def _dkv_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, block_q, block_k, q_blocks):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
+    qs, ks = qs_ref[0], ks_ref[0]
 
     @pl.when(qi == 0)
     def _init():
@@ -218,26 +249,26 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, 0].reshape(block_q, 1)
         delta = delta_ref[0, 0].reshape(block_q, 1)
+        glse = glse_ref[0, 0].reshape(block_q, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            q_pos, k_pos = _positions(qs, ks, qi, ki, block_q, block_k)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse)  # [bq, bk]
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = p * (dp - delta + glse)
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
     if causal:
-        # q blocks entirely above this kv block see none of it
-        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        # q blocks entirely before this kv block see none of it
+        @pl.when(qs + qi * block_q + block_q - 1 >= ks + ki * block_k)
         def _():
             compute()
     else:
@@ -249,13 +280,24 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+def _flash_bwd(q, k, v, o, lse8, do, glse8, q_start, k_start, causal, block_q, block_k, interpret):
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
     scale = d**-0.5
     q_blocks, kv_blocks = s_q // block_q, s_kv // block_k
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [bh, s_q]
     delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, s_q))  # sublane-aligned like lse
+    qrow = [
+        _smem_spec(),
+        _smem_spec(),
+        _vmem_spec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        _vmem_spec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        _vmem_spec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        _vmem_spec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        _vmem_spec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
+        _vmem_spec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
+        _vmem_spec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
+    ]
 
     dq = pl.pallas_call(
         functools.partial(
@@ -263,34 +305,31 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
             block_q=block_q, block_k=block_k, kv_blocks=kv_blocks,
         ),
         grid=(bh, q_blocks, kv_blocks),
-        in_specs=[
-            _vmem_spec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            _vmem_spec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-            _vmem_spec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-            _vmem_spec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            _vmem_spec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
-            _vmem_spec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
-        ],
+        in_specs=qrow,
         out_specs=_vmem_spec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[_scratch((block_q, d))],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(_scalar(q_start), _scalar(k_start), q, k, v, do, lse8, delta, glse8)
 
+    krow = [
+        _smem_spec(),
+        _smem_spec(),
+        _vmem_spec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+        _vmem_spec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+        _vmem_spec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+        _vmem_spec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+        _vmem_spec((1, 8, block_q), lambda b, ki, qi: (b, 0, qi)),
+        _vmem_spec((1, 8, block_q), lambda b, ki, qi: (b, 0, qi)),
+        _vmem_spec((1, 8, block_q), lambda b, ki, qi: (b, 0, qi)),
+    ]
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, q_blocks=q_blocks,
         ),
         grid=(bh, kv_blocks, q_blocks),
-        in_specs=[
-            _vmem_spec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
-            _vmem_spec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
-            _vmem_spec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
-            _vmem_spec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
-            _vmem_spec((1, 8, block_q), lambda b, ki, qi: (b, 0, qi)),
-            _vmem_spec((1, 8, block_q), lambda b, ki, qi: (b, 0, qi)),
-        ],
+        in_specs=krow,
         out_specs=[
             _vmem_spec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
             _vmem_spec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
@@ -301,8 +340,38 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
         ],
         scratch_shapes=[_scratch((block_k, d)), _scratch((block_k, d))],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(_scalar(q_start), _scalar(k_start), q, k, v, do, lse8, delta, glse8)
     return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# differentiable core (out AND lse)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, q_start, k_start, causal, block_q, block_k, interpret):
+    out, lse8 = _flash_fwd(q, k, v, q_start, k_start, causal, block_q, block_k, interpret)
+    return out, lse8[:, 0, :]
+
+
+def _flash_fwd_rule(q, k, v, q_start, k_start, causal, block_q, block_k, interpret):
+    out, lse8 = _flash_fwd(q, k, v, q_start, k_start, causal, block_q, block_k, interpret)
+    return (out, lse8[:, 0, :]), (q, k, v, out, lse8, q_start, k_start)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse8, q_start, k_start = res
+    g_out, g_lse = g
+    bh, s_q, _ = q.shape
+    glse8 = jnp.broadcast_to(g_lse.astype(jnp.float32)[:, None, :], (bh, 8, s_q))
+    dq, dk, dv = _flash_bwd(
+        q, k, v, out, lse8, g_out, glse8, q_start, k_start, causal, block_q, block_k, interpret
+    )
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 # ---------------------------------------------------------------------------
@@ -310,23 +379,41 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out
+def _flat3(t):
+    b, h, s, d = t.shape
+    return t.reshape(b * h, s, d)
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    q_start: jax.Array | int = 0,
+    k_start: jax.Array | int = 0,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Flash attention returning ``(out, lse)``. Shapes: q/k/v
+    [batch, heads, seq, head_dim] → out same-as-q, lse [batch, heads, seq_q]
+    (float32 logsumexp over the kv positions this call saw).
 
-
-def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret)
-
-
-_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+    ``q_start``/``k_start`` are the GLOBAL positions of the first q/k row
+    (traced values allowed) — the causal mask compares global positions, so
+    ring/sharded callers can run any (q-block, kv-block) pair. Both outputs
+    are differentiable; requires the sequence to tile into blocks.
+    """
+    b, h, s_q, d = q.shape
+    s_kv = k.shape[2]
+    bq = _pick_block(s_q, block_q)
+    bk = _pick_block(s_kv, block_k)
+    if bq is None or bk is None:
+        raise ValueError(f"sequence ({s_q}, {s_kv}) does not tile into flash blocks")
+    if interpret is None:
+        interpret = _interpret_default()
+    out, lse = _flash(_flat3(q), _flat3(k), _flat3(v), q_start, k_start, causal, bq, bk, interpret)
+    return out.reshape(b, h, s_q, d), lse.reshape(b, h, s_q)
 
 
 def flash_attention(
@@ -348,19 +435,73 @@ def flash_attention(
     """
     if q.ndim != 4:
         raise ValueError(f"expected [batch, heads, seq, head_dim], got {q.shape}")
-    b, h, s_q, d = q.shape
-    s_kv = k.shape[2]
-    bq = _pick_block(s_q, block_q)
-    bk = _pick_block(s_kv, block_k)
-    if bq is None or bk is None:
+    if _pick_block(q.shape[2], block_q) is None or _pick_block(k.shape[2], block_k) is None:
         from dsml_tpu.ops.attention import attention
 
         return attention(q, k, v, causal)
-    if interpret is None:
-        interpret = _interpret_default()
+    out, _ = flash_attention_lse(q, k, v, causal, 0, 0, block_q, block_k, interpret)
+    return out
 
-    def flat(t):
-        return t.reshape(b * h, t.shape[2], d)
 
-    out = _flash(flat(q), flat(k), flat(v), causal, bq, bk, interpret)
-    return out.reshape(b, h, s_q, d)
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Ring attention with a flash kernel per hop (call under ``shard_map``).
+
+    Each rank holds a sequence shard [batch, heads, seq/n, head_dim]; K/V
+    rotate ``n−1`` hops around the ring. Every hop is ONE
+    :func:`flash_attention_lse` call whose global offsets make the causal
+    mask exact for that (q-shard, kv-shard) pair; the per-hop (out, lse)
+    pairs then merge with logsumexp weights:
+
+        lse_tot = logsumexp_i(lse_i);  out = Σᵢ exp(lse_i − lse_tot)·out_i
+
+    which reconstructs exact full attention (hops that are entirely masked
+    contribute lse ≈ −∞ → weight 0). Scores never exceed
+    O(block_q·block_k) on any chip. Gradients flow through the kernels'
+    custom VJP (including the lse term). Falls back to the XLA ring
+    (``ops.attention.ring_attention``) when the shard doesn't tile.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return flash_attention(q, k, v, causal, block_q, block_k)
+    seq_block = q.shape[-2]
+    if _pick_block(seq_block, block_q) is None or _pick_block(seq_block, block_k) is None:
+        from dsml_tpu.ops.attention import ring_attention
+
+        return ring_attention(q, k, v, axis_name, causal)
+    rank = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Online merge (same shape as ops.attention.ring_attention's fold): only
+    # ONE running (out, lse) pair is alive — stacking all n hops would hold
+    # the full sequence in f32 on every chip, defeating the point of SP.
+    run_out = None
+    run_lse = None
+    kv = (k, v)
+    for hop in range(n):
+        k_off = (rank - hop) % n  # whose K/V block is resident this hop
+        o, l = flash_attention_lse(
+            q, kv[0], kv[1], causal,
+            q_start=rank * seq_block, k_start=k_off * seq_block,
+            block_q=block_q, block_k=block_k,
+        )
+        o = o.astype(jnp.float32)
+        if run_out is None:
+            run_out, run_lse = o, l
+        else:
+            new_lse = jnp.logaddexp(run_lse, l)
+            w_prev = jnp.exp(run_lse - new_lse)[..., None]
+            w_new = jnp.exp(l - new_lse)[..., None]
+            run_out = w_prev * run_out + w_new * o
+            run_lse = new_lse
+        if hop != n - 1:
+            kv = jax.tree.map(lambda t: lax.ppermute(t, axis_name, perm), kv)
+
+    return run_out.astype(q.dtype)

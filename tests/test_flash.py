@@ -63,6 +63,113 @@ def test_flash_falls_back_on_untileable_seq():
     np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_lse_matches_dense_logsumexp(causal):
+    q, k, v = _qkv(s=128, seed=5)
+    from dsml_tpu.ops.flash import flash_attention_lse
+
+    out, lse = flash_attention_lse(q, k, v, causal)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (q.shape[-1] ** -0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((128, 128), bool))
+        scores = jnp.where(mask, scores, -1e30)
+    expected_lse = jax.scipy.special.logsumexp(scores, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(expected_lse), rtol=1e-5, atol=1e-5)
+
+
+def test_flash_offsets_shift_causal_mask():
+    """With k_start far in the past, a causal call must equal a full
+    (unmasked) call; with k_start in the future, output rows are ~uniform
+    over nothing visible (lse ≈ floor)."""
+    from dsml_tpu.ops.flash import flash_attention_lse
+
+    q, k, v = _qkv(s=64, seed=6)
+    past, _ = flash_attention_lse(q, k, v, causal=True, q_start=4096, k_start=0)
+    full, _ = flash_attention_lse(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(past), np.asarray(full), rtol=1e-5, atol=1e-5)
+    _, lse_future = flash_attention_lse(q, k, v, causal=True, q_start=0, k_start=4096)
+    assert float(lse_future.max()) < -1e18  # nothing visible
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_matches_full_attention(mesh8, causal):
+    from jax.sharding import PartitionSpec as P
+
+    from dsml_tpu.ops.flash import ring_flash_attention
+
+    rng = np.random.default_rng(7)
+    b, h, s, d = 1, 2, 256, 16  # 32 rows per rank over 8 devices
+    q, k, v = (jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32) for _ in range(3))
+    expected = np.asarray(attention(q, k, v, causal))
+    spec = P(None, None, "dev", None)
+    got = np.asarray(
+        jax.jit(
+            jax.shard_map(
+                lambda q, k, v: ring_flash_attention(q, k, v, "dev", causal),
+                mesh=mesh8, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+            )
+        )(q, k, v)
+    )
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_flash_gradients_match_full(mesh8):
+    from jax.sharding import PartitionSpec as P
+
+    from dsml_tpu.ops.flash import ring_flash_attention
+
+    rng = np.random.default_rng(8)
+    b, h, s, d = 1, 2, 256, 16
+    q, k, v = (jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32) for _ in range(3))
+    spec = P(None, None, "dev", None)
+
+    def ring_loss(q, k, v):
+        wrapped = jax.shard_map(
+            lambda q, k, v: ring_flash_attention(q, k, v, "dev", True),
+            mesh=mesh8, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+        )
+        return jnp.sum(wrapped(q, k, v) ** 2)
+
+    grads = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    full = jax.jit(
+        jax.grad(lambda q, k, v: jnp.sum(attention(q, k, v, True) ** 2), argnums=(0, 1, 2))
+    )(q, k, v)
+    for g, r in zip(grads, full):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=1e-3, atol=1e-4)
+
+
+def test_gpt2_ring_flash_loss_matches_ring(devices8):
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.parallel.hybrid import hybrid_loss_fn, shard_params
+    from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(9)
+    rng = np.random.default_rng(10)
+    x = jnp.asarray(rng.integers(0, 512, (4, 128)), jnp.int32)
+    y = jnp.roll(x, -1, 1)
+    mesh = build_mesh(MeshSpec(dp=2, sp=4, tp=1), devices8)
+    placed = shard_params(params, mesh, model.param_specs())
+
+    def run(impl):
+        fn = jax.jit(
+            jax.shard_map(
+                lambda p, xx, yy: lax.pmean(hybrid_loss_fn(model, impl)(p, xx, yy), ("dp", "sp")),
+                mesh=mesh,
+                in_specs=(model.param_specs(), P("dp", "sp"), P("dp", "sp")),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+        return float(fn(placed, x, y))
+
+    assert np.isclose(run("ring_flash"), run("ring"), rtol=1e-4)
+
+
 def test_gpt2_flash_attn_impl_matches_default():
     from dsml_tpu.models.gpt2 import GPT2, GPT2Config
 
