@@ -113,12 +113,17 @@ class BufferRegistry:
             self._buffers[addr] = jax.device_put(data, self.device)
             self._last_write[addr] = nbytes_in
 
-    def put_array(self, addr: int, arr: jax.Array) -> None:
-        """Store an already-on-device array (zero-copy path for collectives)."""
+    def put_array(self, addr: int, arr: jax.Array, logical_nbytes: int | None = None) -> None:
+        """Store an already-on-device array (zero-copy path for collectives).
+
+        ``logical_nbytes`` records a payload size smaller than the physical
+        array — the collective fast path splices a reduced prefix into a
+        larger resident buffer on device, mirroring :meth:`write`'s splice
+        semantics (which set the logical size to the bytes written)."""
         self.check_bounds(addr, arr.nbytes)
         with self._lock:
             self._buffers[addr] = arr
-            self._last_write[addr] = arr.nbytes
+            self._last_write[addr] = logical_nbytes if logical_nbytes is not None else arr.nbytes
 
     def logical_nbytes(self, addr: int) -> int:
         """Size of the most recent payload written at ``addr`` (≤ physical)."""

@@ -50,6 +50,7 @@ __all__ = [
     "all_to_all",
     "ppermute_ring",
     "make_stacked_all_reduce",
+    "device_buffers_all_reduce",
 ]
 
 
@@ -328,6 +329,76 @@ def _stacked_all_reduce_fn(
         return x[None]
 
     return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _buffer_all_reduce_fn(mesh: Mesh, axis_name: str, op: ReduceOp, algorithm: str, dtype_str: str):
+    """Jitted byte-buffer all-reduce: per-shard [1, count] uint8 in/out,
+    reinterpreted as ``dtype_str`` for the reduction. NO donation — the
+    inputs are the device servers' live registry buffers, which must stay
+    valid for later Memcpy reads."""
+    spec = P(axis_name)
+    dt = jnp.dtype(dtype_str)
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=NamedSharding(mesh, spec),
+        out_shardings=NamedSharding(mesh, spec),
+    )
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+    )
+    def fn(stacked_u8):  # [1, count] uint8 per shard
+        flat = stacked_u8[0]
+        if dt.itemsize > 1:
+            x = lax.bitcast_convert_type(flat.reshape(-1, dt.itemsize), dt)
+        else:
+            x = lax.bitcast_convert_type(flat, dt)
+        x = all_reduce(x, axis_name, op, algorithm)
+        u8 = lax.bitcast_convert_type(x, jnp.uint8)
+        return u8.reshape(-1)[None]
+
+    return fn
+
+
+def device_buffers_all_reduce(
+    buffers: Sequence[jax.Array],
+    mesh: Mesh,
+    op: ReduceOp = ReduceOp.SUM,
+    algorithm: str = "ring",
+    dtype: str = "float32",
+) -> list[jax.Array]:
+    """All-reduce per-chip byte buffers WITHOUT any host round-trip.
+
+    ``buffers[i]`` is a flat uint8 ``jax.Array`` resident on
+    ``mesh.devices.flat[i]`` (the device server's registry buffer, viewed as
+    ``dtype`` for the reduction). The shards are assembled into one global
+    array in place (``jax.make_array_from_single_device_arrays`` — no
+    copies), the jitted ring/psum program runs over the mesh, and the result
+    comes back as one on-device array per chip, ready for
+    ``BufferRegistry.put_array``. This is the coordinator's local-chip fast
+    path: the reference shipped every ring step through gRPC + host memory
+    (``gpu_coordinator_server.go:427-515``); here the ends stay in HBM too.
+    """
+    axis_name = mesh.axis_names[0]
+    n = mesh.shape[axis_name]
+    if len(buffers) != n:
+        raise ValueError(f"expected {n} buffers for mesh axis {axis_name!r}, got {len(buffers)}")
+    count = buffers[0].shape[0]
+    if count % np.dtype(dtype).itemsize:
+        raise ValueError(f"{count} bytes is not a multiple of {dtype} itemsize")
+    for i, b in enumerate(buffers):
+        if b.ndim != 1 or b.dtype != jnp.uint8 or b.shape[0] != count:
+            raise ValueError(f"buffer {i}: expected flat uint8[{count}], got {b.dtype}{b.shape}")
+    sharding = NamedSharding(mesh, P(axis_name))
+    global_arr = jax.make_array_from_single_device_arrays(
+        (n, count), sharding, [b.reshape(1, count) for b in buffers]
+    )
+    out = _buffer_all_reduce_fn(mesh, axis_name, ReduceOp(op), algorithm, str(np.dtype(dtype)))(
+        global_arr
+    )
+    per_device = {s.device: s.data for s in out.addressable_shards}
+    return [per_device[d].reshape(-1) for d in mesh.devices.flat]
 
 
 def make_stacked_all_reduce(

@@ -129,6 +129,57 @@ def test_ring_all_reduce_honors_mem_addrs(cluster):
     np.testing.assert_array_equal(bytes_to_f32(client.read(1, 0x5000, 64)), np.full(16, 5.0))
 
 
+def test_all_reduce_local_chips_is_zero_copy(cluster, monkeypatch):
+    """When every communicator device is a distinct local chip, the
+    collective must feed the jitted ring straight from HBM-resident registry
+    buffers — any D2H/H2D host round-trip through the coordinator is a bug
+    (the zero-copy design ``device_server.py`` states at ``put_array``)."""
+    devices, coordinator = cluster
+    client = _connect(cluster, n=4)
+    grads = [np.full(1024, float(i + 1), np.float32) for i in range(4)]
+    for rank, g in enumerate(grads):
+        client.write(rank, GRAD_ADDR, f32_to_bytes(g))
+
+    def boom(*a, **k):
+        raise AssertionError("host copy on the local-chip collective path")
+
+    monkeypatch.setattr(coordinator.runtime, "_fetch_bytes", boom)
+    monkeypatch.setattr(coordinator.runtime, "_store_bytes", boom)
+    client.all_reduce_ring(1024 * 4)
+    monkeypatch.undo()
+    for rank in range(4):
+        got = bytes_to_f32(client.read(rank, GRAD_ADDR, 1024 * 4))
+        np.testing.assert_allclose(got, np.sum(grads, axis=0), rtol=1e-6)
+
+
+def test_all_reduce_partial_count_preserves_tail(cluster):
+    """Reducing a prefix of a larger resident buffer must splice: the
+    reduced bytes land in the prefix, the tail stays intact (write()'s
+    partial-write semantics, kept by the zero-copy path on device)."""
+    client = _connect(cluster, n=2)
+    a = np.arange(8, dtype=np.float32)
+    b = np.arange(8, 16, dtype=np.float32)
+    client.write(0, 0x4000, f32_to_bytes(a))
+    client.write(1, 0x4000, f32_to_bytes(b))
+    client.all_reduce_ring(16, mem_addrs={0: 0x4000, 1: 0x4000})  # first 4 floats
+    for rank, orig in ((0, a), (1, b)):
+        got = bytes_to_f32(client.read(rank, 0x4000, 32))
+        np.testing.assert_allclose(got[:4], a[:4] + b[:4], rtol=1e-6)
+        np.testing.assert_array_equal(got[4:], orig[4:])
+
+
+def test_all_reduce_host_fallback_matches_zero_copy(cluster, monkeypatch):
+    """With the local-chip mesh unavailable (cross-host shape), the host
+    gather→reduce→store path must produce the same values."""
+    devices, coordinator = cluster
+    client = _connect(cluster, n=4)
+    monkeypatch.setattr(coordinator.runtime, "_comm_mesh", lambda comm: None)
+    rng = np.random.default_rng(7)
+    grads = [rng.standard_normal(257).astype(np.float32) for _ in range(4)]
+    reduced = client.all_reduce_gradients(grads)
+    np.testing.assert_allclose(reduced, np.sum(grads, axis=0), rtol=1e-5, atol=1e-6)
+
+
 def test_concurrent_communicators_are_independent(cluster):
     """Two live communicators over disjoint device sets (untested in the
     reference, SURVEY.md §4.4 'concurrent communicators'): collectives on one
