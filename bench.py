@@ -289,36 +289,41 @@ def _virtual8_main() -> None:
     # full proto-API path: gRPC client → coordinator → zero-copy HBM ring.
     # On this CPU mesh the number mostly shows the control-plane cost (device
     # "HBM" is host memory here); on real chips it tracks that the data
-    # plane stays off the host.
-    import numpy as np
+    # plane stays off the host. Failures here must not discard the ring/naive
+    # numbers already measured above.
+    wire_e2e = None
+    try:
+        import numpy as np
 
-    from dsml_tpu.comm.client import GRAD_ADDR, PipelineClient
-    from dsml_tpu.comm.coordinator import CoordinatorConfig, serve_coordinator
-    from dsml_tpu.comm.device_server import serve_local_devices
+        from dsml_tpu.comm.client import GRAD_ADDR, PipelineClient
+        from dsml_tpu.comm.coordinator import CoordinatorConfig, serve_coordinator
+        from dsml_tpu.comm.device_server import serve_local_devices
 
-    devices = serve_local_devices(8, base_device_id=1, mem_size=0x800000)
-    coordinator = serve_coordinator(config=CoordinatorConfig(health_interval_s=60))
-    client = PipelineClient.connect(coordinator.address, [d.address for d in devices])
-    payload = np.zeros(262_144, np.float32)  # 1 MB
-    for rank in range(8):
-        client.write(rank, GRAD_ADDR, payload.tobytes())
-    client.all_reduce_ring(262_144 * 4)  # compile + warm
-    ts = []
-    for _ in range(20):
-        t0 = time.monotonic()
-        client.all_reduce_ring(262_144 * 4)
-        ts.append((time.monotonic() - t0) * 1e3)
-    wire_e2e = float(np.percentile(ts, 50))
-    coordinator.stop()
-    for d in devices:
-        d.stop()
+        devices = serve_local_devices(8, base_device_id=1, mem_size=0x800000)
+        coordinator = serve_coordinator(config=CoordinatorConfig(health_interval_s=60))
+        client = PipelineClient.connect(coordinator.address, [d.address for d in devices])
+        payload = np.zeros(262_144, np.float32)  # 1 MB
+        for rank in range(8):
+            client.write(rank, GRAD_ADDR, payload.tobytes())
+        client.all_reduce_ring(262_144 * 4)  # compile + warm
+        ts = []
+        for _ in range(20):
+            t0 = time.monotonic()
+            client.all_reduce_ring(262_144 * 4)
+            ts.append((time.monotonic() - t0) * 1e3)
+        wire_e2e = round(float(np.percentile(ts, 50)), 3)
+        coordinator.stop()
+        for d in devices:
+            d.stop()
+    except Exception:
+        pass
 
     print(
         json.dumps(
             {
                 "ring_ms": round(ring, 3),
                 "naive_ms": round(naive, 3),
-                "wire_e2e_ms": round(wire_e2e, 3),
+                "wire_e2e_ms": wire_e2e,
             }
         )
     )

@@ -323,12 +323,32 @@ class GPT2:
 
     def _moe_block(self, moe, x, tp_axis):
         """Top-k gated mixture of experts with experts sharded over
-        ``tp_axis`` (expert parallelism). Activations are replicated across
-        tp (Megatron invariant), so every rank routes identically, processes
-        only its resident expert shard, and the partial outputs ``psum`` —
-        expert parallelism with the same one-collective cost shape as the
-        dense MLP. Switch-style dense dispatch (static shapes, capacity-
-        bounded, overflow dropped) keeps everything MXU-friendly."""
+        ``tp_axis`` — real expert parallelism: token payloads ride
+        ``all_to_all`` over the expert axis.
+
+        Activations are replicated across tp (Megatron invariant), so every
+        tp rank computes the same Switch-style dense dispatch (static
+        shapes, capacity-bounded over this dp×sp shard's tokens, overflow
+        dropped) — identical routing on every tp rank, which is what makes
+        the exchange exact: each capacity slot (e, c) is owned by exactly
+        ONE token. Each rank then builds partial expert inputs from only
+        its 1/ep token slice, ``all_to_all`` ships the slot payloads to the
+        rank owning each expert shard (disjoint slots → summing the
+        received blocks reconstructs the buffers exactly), the resident
+        experts run, and a second ``all_to_all`` + token ``all_gather``
+        route the combined outputs back to replication (the standard MoE
+        dispatch/return pair). Per-rank einsum FLOPs and return traffic
+        match the replicated+psum alternative; the dispatch hop carries the
+        capacity buffers (≈ top_k·capacity_factor·T·d/ep per rank).
+
+        Values equal the single-device forward up to f32 reduction order
+        (tests pin loss AND gradient parity) — with the caveat that
+        routing/capacity are computed per dp×sp token shard, so drop
+        patterns under capacity overflow differ from a global-batch
+        dispatch (standard local-group MoE semantics).
+
+        Falls back to replicated dispatch + psum when the token count
+        doesn't split over ep."""
         cfg = self.config
         b, s, d = x.shape
         n_exp = cfg.n_experts
@@ -358,19 +378,52 @@ class GPT2:
         combine = (disp * top_p.reshape(t, cfg.expert_top_k)[:, :, None, None]).sum(1)  # [T, E, C]
         disp = disp.sum(1)  # [T, E, C]
 
-        if ep > 1:
-            r = lax.axis_index(tp_axis)
-            disp = lax.dynamic_slice_in_dim(disp, r * exp_local, exp_local, axis=1)
-            combine = lax.dynamic_slice_in_dim(combine, r * exp_local, exp_local, axis=1)
+        use_a2a = ep > 1 and t % ep == 0
+        r = lax.axis_index(tp_axis) if ep > 1 else 0
+        if use_a2a:
+            from dsml_tpu.ops.collectives import all_gather, all_to_all
 
-        expert_in = jnp.einsum("td,tec->ecd", tokens, disp)  # [E_local, C, d]
+            # this rank's token slice → partial [E, C, d] (zeros outside the
+            # slots its tokens own)
+            t_local = t // ep
+            tok_r = lax.dynamic_slice_in_dim(tokens, r * t_local, t_local, axis=0)
+            disp_r = lax.dynamic_slice_in_dim(disp, r * t_local, t_local, axis=0)
+            partial = jnp.einsum("td,tec->ecd", tok_r, disp_r)  # [E, C, d]
+            # all_to_all over experts: send [E_local, C, d] blocks, receive
+            # the ep partials for OUR experts concatenated on the capacity
+            # axis; slots are disjoint so the sum is the exact buffer
+            recv = all_to_all(partial, tp_axis, split_axis=0, concat_axis=1)
+            expert_in = recv.reshape(exp_local, ep, capacity, d).sum(axis=1)
+        elif ep > 1:
+            disp_l = lax.dynamic_slice_in_dim(disp, r * exp_local, exp_local, axis=1)
+            expert_in = jnp.einsum("td,tec->ecd", tokens, disp_l)
+        else:
+            expert_in = jnp.einsum("td,tec->ecd", tokens, disp)
+
         hmid = jax.nn.gelu(
             jnp.einsum("ecd,edf->ecf", expert_in, moe["w_in"]) + moe["b_in"][:, None, :]
         )
         expert_out = jnp.einsum("ecf,efd->ecd", hmid, moe["w_out"]) + moe["b_out"][:, None, :]
-        out = jnp.einsum("ecd,tec->td", expert_out, combine)
-        if ep > 1:
-            out = lax.psum(out, tp_axis)
+
+        if use_a2a:
+            # return path: each expert-owner computes partial outputs for
+            # EVERY token from its resident experts (T·E_local·C·d FLOPs, the
+            # same as the psum alternative), then a SECOND all_to_all routes
+            # each token slice's partials to its owner rank — the standard
+            # MoE return — and a token all_gather restores replication.
+            # ~2·T·d bytes moved, matching the psum it replaces.
+            combine_l = lax.dynamic_slice_in_dim(combine, r * exp_local, exp_local, axis=1)
+            partial_out = jnp.einsum("ecd,tec->td", expert_out, combine_l)  # [T, d]
+            recv = all_to_all(
+                partial_out.reshape(ep, t_local, d), tp_axis, split_axis=0, concat_axis=0
+            )  # [ep, T_local, d]: block i = rank i's partial for OUR tokens
+            out_r = recv.sum(axis=0)  # [T_local, d]
+            out = all_gather(out_r, tp_axis, axis=0, tiled=True)  # [T, d] replicated
+        elif ep > 1:
+            combine_l = lax.dynamic_slice_in_dim(combine, r * exp_local, exp_local, axis=1)
+            out = lax.psum(jnp.einsum("ecd,tec->td", expert_out, combine_l), tp_axis)
+        else:
+            out = jnp.einsum("ecd,tec->td", expert_out, combine)
         return out.reshape(b, s, d)
 
     # ---- loss ------------------------------------------------------------------

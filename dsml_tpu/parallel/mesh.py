@@ -14,9 +14,11 @@ strategy:
 
 Axis order is laid out so the highest-traffic axes map to adjacent chips on
 the ICI torus (XLA assigns the innermost mesh axis the fastest locality); EP
-(expert parallel) aliases onto (dp×fsdp) at MoE layers via all_to_all rather
-than occupying a dedicated mesh axis — the LoongTrain/DeepSpeed-style 2D
-split of fast/slow interconnect (SURVEY.md §5.7).
+(expert parallel) aliases onto the ``tp`` axis at MoE layers — experts are
+sharded over tp and token payloads ride ``all_to_all`` across it
+(``models/gpt2.py:_moe_block``) — rather than occupying a dedicated mesh
+axis, keeping the expert exchange on the fastest interconnect (the
+LoongTrain/DeepSpeed-style fast/slow split, SURVEY.md §5.7).
 """
 
 from __future__ import annotations
