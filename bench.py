@@ -292,6 +292,8 @@ def _virtual8_main() -> None:
     # plane stays off the host. Failures here must not discard the ring/naive
     # numbers already measured above.
     wire_e2e = None
+    wire_err = None
+    coordinator, devices = None, []
     try:
         import numpy as np
 
@@ -312,21 +314,24 @@ def _virtual8_main() -> None:
             client.all_reduce_ring(262_144 * 4)
             ts.append((time.monotonic() - t0) * 1e3)
         wire_e2e = round(float(np.percentile(ts, 50)), 3)
-        coordinator.stop()
+    except Exception as e:
+        wire_err = repr(e)[:200]
+    finally:
+        # servers must die even on failure, or their threads can outlive the
+        # subprocess timeout and discard the ring/naive numbers printed below
+        if coordinator is not None:
+            coordinator.stop()
         for d in devices:
             d.stop()
-    except Exception:
-        pass
 
-    print(
-        json.dumps(
-            {
-                "ring_ms": round(ring, 3),
-                "naive_ms": round(naive, 3),
-                "wire_e2e_ms": wire_e2e,
-            }
-        )
-    )
+    out = {
+        "ring_ms": round(ring, 3),
+        "naive_ms": round(naive, 3),
+        "wire_e2e_ms": wire_e2e,
+    }
+    if wire_err:
+        out["wire_e2e_error"] = wire_err
+    print(json.dumps(out))
 
 
 def bench_ring_virtual8() -> dict:

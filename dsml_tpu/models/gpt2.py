@@ -223,26 +223,52 @@ class GPT2:
         h = self._hidden_spmd(params, tokens, tp_axis, sp_axis, attn_impl, seq_offset, pp_axis, n_micro)
         return h @ params["wte"].T  # tied unembedding → [b, s, vocab/tp]
 
-    def _hidden_spmd(
-        self, params, tokens, tp_axis=None, sp_axis=None, attn_impl="ring",
-        seq_offset=None, pp_axis=None, n_micro=1,
-    ):
-        """Forward to the final-layer-norm hidden states [b, s, d] (shared by
-        the logits head and the chunked-xent loss that never builds logits)."""
+    def _head_loss_spmd(self, params, h_raw, targets, tp_axis=None):
+        """Final norm + tied unembedding + next-token CE for PRE-final-norm
+        hidden states ``h_raw`` [b, s, d] → scalar mean loss. The head the
+        pipeline's last stage owns; shared by :meth:`loss_spmd` and the 1F1B
+        schedule (which must run it per microbatch, inside the schedule)."""
         cfg = self.config
+        h = _layer_norm(h_raw, **params["ln_f"])
         tp_size = lax.axis_size(tp_axis) if tp_axis else 1
-        if cfg.n_head % tp_size:
-            raise ValueError(f"n_head={cfg.n_head} not divisible by tp={tp_size}")
-        n_head_local = cfg.n_head // tp_size
+        if tp_size == 1:
+            if cfg.xent_chunk and cfg.vocab_size > cfg.xent_chunk:
+                # big unsharded vocab: stream the unembedding — [tokens,
+                # vocab] logits never exist (ops/xent.py)
+                from dsml_tpu.ops.xent import chunked_softmax_xent
+
+                return chunked_softmax_xent(h, params["wte"], targets, cfg.xent_chunk)
+            logits = (h @ params["wte"].T).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+            return nll.mean()
+        logits = (h @ params["wte"].T).astype(jnp.float32)
+        vocab_shard = logits.shape[-1]
+        tp_rank = lax.axis_index(tp_axis)
+        # distributed logsumexp (max-shift carries no gradient, and pmax has
+        # no VJP rule — stop_gradient on both)
+        local_max = lax.stop_gradient(logits.max(-1, keepdims=True))
+        global_max = lax.stop_gradient(lax.pmax(local_max, tp_axis))
+        sumexp = jnp.sum(jnp.exp(logits - global_max), axis=-1, keepdims=True)
+        lse = jnp.log(lax.psum(sumexp, tp_axis)) + global_max  # [b, s, 1]
+        # target logit lives on exactly one shard
+        local_ids = targets - tp_rank * vocab_shard
+        in_shard = (local_ids >= 0) & (local_ids < vocab_shard)
+        safe_ids = jnp.clip(local_ids, 0, vocab_shard - 1)
+        tgt = jnp.take_along_axis(logits, safe_ids[..., None], axis=-1)
+        tgt = lax.psum(jnp.where(in_shard[..., None], tgt, 0.0), tp_axis)
+        return jnp.mean(lse - tgt)
+
+    def _embed_spmd(self, params, tokens, tp_axis=None, sp_axis=None, seq_offset=None):
+        """Token + position embedding → [b, s_local, d]. ``wte`` is
+        vocab-sharded over tp → masked gather + psum (each token's row lives
+        on exactly one shard); positions offset by this rank's sp shard."""
         seq_local = tokens.shape[1]
         if sp_axis:
             sp_rank = lax.axis_index(sp_axis)
             pos = sp_rank * seq_local + jnp.arange(seq_local)
         else:
             pos = jnp.arange(seq_local) + (seq_offset or 0)
-
-        # embedding: wte is vocab-sharded over tp → masked gather + psum
-        # (each token's row lives on exactly one shard)
         if tp_axis:
             vocab_shard = params["wte"].shape[0]
             tp_rank = lax.axis_index(tp_axis)
@@ -252,10 +278,31 @@ class GPT2:
             h = lax.psum(params["wte"][safe_ids] * in_shard[..., None], tp_axis)
         else:
             h = params["wte"][tokens]
-        h = h + params["wpe"][pos]
+        return h + params["wpe"][pos]
+
+    def _block_closure(self, tp_axis, sp_axis, attn_impl):
+        """``block(one_layer_params, x) -> x`` for the current sharding —
+        the unit both pipeline schedules stream microbatches through."""
+        cfg = self.config
+        tp_size = lax.axis_size(tp_axis) if tp_axis else 1
+        if cfg.n_head % tp_size:
+            raise ValueError(f"n_head={cfg.n_head} not divisible by tp={tp_size}")
+        n_head_local = cfg.n_head // tp_size
 
         def block(layer, x):
             return self._block(layer, x, n_head_local, tp_axis, sp_axis, attn_impl)
+
+        return block
+
+    def _blocks_spmd(
+        self, params, tokens, tp_axis=None, sp_axis=None, attn_impl="ring",
+        seq_offset=None, pp_axis=None, n_micro=1,
+    ):
+        """Embedding + transformer block stack → PRE-final-norm hidden
+        states [b, s, d]."""
+        cfg = self.config
+        block = self._block_closure(tp_axis, sp_axis, attn_impl)
+        h = self._embed_spmd(params, tokens, tp_axis, sp_axis, seq_offset)
 
         if pp_axis:
             from dsml_tpu.parallel.pp import pipeline_apply
@@ -274,7 +321,17 @@ class GPT2:
                 block = jax.checkpoint(block)
             for layer in params["layers"]:
                 h = block(layer, h)
+        return h
 
+    def _hidden_spmd(
+        self, params, tokens, tp_axis=None, sp_axis=None, attn_impl="ring",
+        seq_offset=None, pp_axis=None, n_micro=1,
+    ):
+        """Forward to the final-layer-norm hidden states [b, s, d] (shared by
+        the logits head and the chunked-xent loss that never builds logits)."""
+        h = self._blocks_spmd(
+            params, tokens, tp_axis, sp_axis, attn_impl, seq_offset, pp_axis, n_micro
+        )
         return _layer_norm(h, **params["ln_f"])
 
     def _block(self, layer, h, n_head_local, tp_axis, sp_axis, attn_impl):
@@ -449,51 +506,85 @@ class GPT2:
         embedding's on rank 0 via the pipeline feed mask), letting the caller
         reconstruct full non-layer grads with one psum over pp
         (``parallel.hybrid``)."""
-        cfg = self.config
-
-        def finalize(loss):
-            if pp_axis:
-                is_last = lax.axis_index(pp_axis) == lax.axis_size(pp_axis) - 1
-                loss = lax.psum(jnp.where(is_last, loss, 0.0), pp_axis)
-            return loss
-
-        # tp of size 1 (the hybrid step always has a tp axis, often unit —
-        # e.g. GPT-2-small pure-DP) is an UNsharded vocab: route it to the
-        # chunked/dense single-shard path, not the TP logits path
-        tp_size = lax.axis_size(tp_axis) if tp_axis else 1
-        if tp_size == 1:
-            h = self._hidden_spmd(
-                params, tokens, tp_axis, sp_axis, attn_impl, pp_axis=pp_axis, n_micro=n_micro
-            )
-            if cfg.xent_chunk and cfg.vocab_size > cfg.xent_chunk:
-                # big unsharded vocab: stream the unembedding — [tokens,
-                # vocab] logits never exist (ops/xent.py)
-                from dsml_tpu.ops.xent import chunked_softmax_xent
-
-                return finalize(chunked_softmax_xent(h, params["wte"], targets, cfg.xent_chunk))
-            logits = (h @ params["wte"].T).astype(jnp.float32)
-            logp = jax.nn.log_softmax(logits)
-            nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-            return finalize(nll.mean())
-
-        logits = self.apply_spmd(
+        h_raw = self._blocks_spmd(
             params, tokens, tp_axis, sp_axis, attn_impl, pp_axis=pp_axis, n_micro=n_micro
-        ).astype(jnp.float32)
-        vocab_shard = logits.shape[-1]
-        tp_rank = lax.axis_index(tp_axis)
-        # distributed logsumexp (max-shift carries no gradient, and pmax has
-        # no VJP rule — stop_gradient on both)
-        local_max = lax.stop_gradient(logits.max(-1, keepdims=True))
-        global_max = lax.stop_gradient(lax.pmax(local_max, tp_axis))
-        sumexp = jnp.sum(jnp.exp(logits - global_max), axis=-1, keepdims=True)
-        lse = jnp.log(lax.psum(sumexp, tp_axis)) + global_max  # [b, s, 1]
-        # target logit lives on exactly one shard
-        local_ids = targets - tp_rank * vocab_shard
-        in_shard = (local_ids >= 0) & (local_ids < vocab_shard)
-        safe_ids = jnp.clip(local_ids, 0, vocab_shard - 1)
-        tgt = jnp.take_along_axis(logits, safe_ids[..., None], axis=-1)
-        tgt = lax.psum(jnp.where(in_shard[..., None], tgt, 0.0), tp_axis)
-        return finalize(jnp.mean(lse - tgt))
+        )
+        # tp of size 1 (the hybrid step always has a tp axis, often unit —
+        # e.g. GPT-2-small pure-DP) is an UNsharded vocab: _head_loss_spmd
+        # routes it to the chunked/dense single-shard path, not TP logits
+        loss = self._head_loss_spmd(params, h_raw, targets, tp_axis)
+        if pp_axis:
+            is_last = lax.axis_index(pp_axis) == lax.axis_size(pp_axis) - 1
+            loss = lax.psum(jnp.where(is_last, loss, 0.0), pp_axis)
+        return loss
+
+    def train_grads_1f1b_spmd(
+        self,
+        params: dict,
+        tokens: jax.Array,
+        targets: jax.Array,
+        tp_axis: str | None = None,
+        sp_axis: str | None = None,
+        attn_impl: str = "ring",
+        pp_axis: str = "pp",
+        n_micro: int = 1,
+        batch_axes: tuple = ("dp", "sp"),
+    ):
+        """Per-rank (loss, grads) via the hand-interleaved 1F1B pipeline
+        schedule (``parallel.pp.pipeline_train_1f1b``) — must run under
+        ``shard_map(check_vma=True)``.
+
+        Grads come back already reduced to each leaf's replication (the
+        schedule's internal-psum semantics; the head seed carries the
+        1/(M·n_dp·n_sp) normalization of the global-mean loss), so the
+        caller uses them as-is. The returned loss is nonzero on the LAST
+        pp rank only: reduce with psum over pp + pmean over the batch axes.
+
+        The embedding runs (replicated) outside the schedule under its own
+        VJP; its cotangent is stage 0's input cotangent (``d_micros``),
+        psummed over pp (rank-0 masked) and tp (per-rank partials of the
+        tp-replicated residual stream) before the pullback."""
+        from dsml_tpu.parallel.pp import pipeline_train_1f1b
+
+        b = tokens.shape[0]
+        if b % n_micro:
+            raise ValueError(f"per-rank batch {b} not divisible by n_micro={n_micro}")
+        block = self._block_closure(tp_axis, sp_axis, attn_impl)
+        head_params = {k: v for k, v in params.items() if k != "layers"}
+
+        h, embed_vjp = jax.vjp(
+            lambda hp: self._embed_spmd(hp, tokens, tp_axis, sp_axis), head_params
+        )
+        micros = h.reshape(n_micro, b // n_micro, *h.shape[1:])
+        tgt_micros = targets.reshape(n_micro, b // n_micro, *targets.shape[1:])
+        vary_axes = tuple(
+            a for a in (pp_axis, *batch_axes, tp_axis, sp_axis) if a is not None
+        )
+        batch_ranks = 1
+        for a in batch_axes:
+            batch_ranks *= lax.axis_size(a)
+
+        def stage_fn(stage_layers, x):
+            def body(hh, one_layer):
+                return block(one_layer, hh), None
+
+            out, _ = lax.scan(body, x, stage_layers)
+            return out
+
+        def head_fn(hp, y, tgt):
+            return self._head_loss_spmd(hp, y, tgt, tp_axis)
+
+        loss, d_stage, d_head, d_micros = pipeline_train_1f1b(
+            stage_fn, head_fn, params["layers"], head_params, micros, tgt_micros,
+            pp_axis, vary_axes=vary_axes, loss_seed_scale=1.0 / (n_micro * batch_ranks),
+        )
+        # cotangent of the (pp/tp-replicated) embedded stream: rank 0 holds
+        # the pipeline's feed cotangent, tp ranks hold partials
+        sum_axes = (pp_axis,) + ((tp_axis,) if tp_axis else ())
+        d_h = lax.psum(d_micros.reshape(b, *h.shape[1:]), sum_axes)
+        (d_embed,) = embed_vjp(d_h)
+        grads_head = jax.tree.map(jnp.add, d_head, d_embed)
+        return loss, {**grads_head, "layers": d_stage}
 
     # ---- single-device conveniences (parity + Trainer protocol) ----------------
 

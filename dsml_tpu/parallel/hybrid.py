@@ -58,6 +58,7 @@ def make_hybrid_train_step(
     attn_impl: str = "ring",
     grad_accum: int = 1,
     n_microbatches: int = 1,
+    schedule: str = "gpipe",
 ):
     """Build ``step(params, opt_state, x, y) -> (params, opt_state, loss)``.
 
@@ -67,9 +68,22 @@ def make_hybrid_train_step(
     "data-parallel AllReduce + grad accumulation" config).
 
     When the mesh has pp > 1, the transformer block stack additionally runs
-    as a GPipe pipeline of ``n_microbatches`` per step (params must be the
+    as a pipeline of ``n_microbatches`` per step (params must be the
     STACKED form from :func:`init_hybrid`): the full pp×dp×sp×tp hybrid.
+    ``schedule`` picks the pipeline schedule:
+
+    - ``"gpipe"`` — synchronous GPipe: forward scan + ``jax.grad``'s
+      mirrored backward; stores one residual set per tick (O(M) activation
+      memory) unless ``config.remat`` rematerializes stages.
+    - ``"1f1b"`` — hand-interleaved one-forward-one-backward
+      (``parallel.pp.pipeline_train_1f1b``): each microbatch's backward
+      starts as soon as its forward completes, in-flight activations are
+      schedule-bounded at ≤ 2(pp−1)+1 microbatches with stage recompute.
+      Same bubble fraction as GPipe (synchronous flush), much flatter
+      memory in M.
     """
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     pp_size = mesh.shape.get("pp", 1)
     pp_axis = "pp" if pp_size > 1 else None
     pspecs = model.param_specs(pp=bool(pp_axis))
@@ -93,7 +107,7 @@ def make_hybrid_train_step(
         check_vma=False,
     )
 
-    def sharded_grads(params, x, y):
+    def gpipe_grads(params, x, y):
         # Differentiate OUTSIDE shard_map: the outer grad seeds the
         # replicated loss once and shard_map's transpose machinery assigns
         # every collective's cotangent correctly (psum of per-rank
@@ -102,6 +116,39 @@ def make_hybrid_train_step(
         # per rank and inflate every psum-crossing gradient by the axis size
         # (tp, and pp's masked-head psum) — a silent n× lr scale.
         return jax.value_and_grad(sharded_loss)(params, x, y)
+
+    def _1f1b_per_rank(params, x, y):
+        # 1F1B differentiates INSIDE shard_map (per-tick jax.vjp — that is
+        # what lets forward and backward interleave), which is sound only
+        # under check_vma=True: vma tracking gives collective transposes
+        # their exact cotangents, and the transpose of each auto-lifted
+        # replicated input psums its cotangent across the lifted axes right
+        # inside the per-tick vjp. With the schedule's seed carrying the
+        # 1/(M·n_dp·n_sp) normalization, grads therefore arrive already
+        # reduced to each leaf's replication — no further psums here.
+        loss, grads = model.train_grads_1f1b_spmd(
+            params, x, y, tp_axis="tp", sp_axis="sp", attn_impl=attn_impl,
+            pp_axis="pp", n_micro=n_microbatches,
+        )
+        # loss is masked to the last pp rank; batch axes hold genuinely
+        # different values (mean them); remaining marked axes (tp) hold
+        # equal values (pmean is an identity that clears the marking)
+        loss = lax.psum(loss, "pp")
+        rest = tuple(jax.typeof(loss).vma)
+        if rest:
+            loss = lax.pmean(loss, rest)
+        return loss, grads
+
+    if pp_axis and schedule == "1f1b":
+        sharded_grads = jax.shard_map(
+            _1f1b_per_rank,
+            mesh=mesh,
+            in_specs=(pspecs, batch_spec, batch_spec),
+            out_specs=(P(), pspecs),
+            check_vma=True,
+        )
+    else:
+        sharded_grads = gpipe_grads
 
     def step(params, opt_state, x, y):
         if grad_accum == 1:

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["pipeline_apply", "stack_layer_params", "pipeline_specs"]
+__all__ = ["pipeline_apply", "pipeline_train_1f1b", "stack_layer_params", "pipeline_specs"]
 
 
 def stack_layer_params(layer_params: list) -> dict:
@@ -88,6 +88,13 @@ def pipeline_apply(
         feed = microbatches[jnp.clip(t, 0, n_micro - 1)]
         x_in = jnp.where(rank == 0, feed, buf)
         active = (t - rank >= 0) & (t - rank < n_micro)
+        # `where`, NOT `lax.cond`: the stage contains collectives (tp psums,
+        # sp ring attention), and a collective instruction's channel spans
+        # every device in the program — ranks whose pp-varying predicate
+        # skips the branch would desert the exchange and corrupt it
+        # (empirically: wrong forward values, not a deadlock). Bubble ticks
+        # therefore compute-and-discard; that waste is inherent to SPMD
+        # lockstep, and 1F1B's zero-seed backward shares it.
         y = jnp.where(active, stage_fn(x_in), jnp.zeros_like(x_in))
         # last stage completes microbatch (t - n_stage + 1)
         out_idx = t - (n_stage - 1)
@@ -103,3 +110,151 @@ def pipeline_apply(
     # outputs are resident on the last stage only; replicate so every rank
     # (e.g. a colocated loss/unembed) can proceed
     return lax.psum(jnp.where(rank == n_stage - 1, outputs, 0.0), axis)
+
+
+def _lift(x, axes: tuple) -> jax.Array:
+    """Mark ``x`` varying over any of ``axes`` it isn't already (identity on
+    values) — keeps scan-carry vma types stable across ticks."""
+    missing = tuple(a for a in axes if a not in jax.typeof(x).vma)
+    return lax.pcast(x, missing, to="varying") if missing else x
+
+
+def pipeline_train_1f1b(
+    stage_fn: Callable,
+    head_fn: Callable,
+    stage_params,
+    head_params,
+    micros: jax.Array,
+    targets: jax.Array,
+    axis: str = "pp",
+    vary_axes: tuple = ("pp", "dp", "sp", "tp"),
+    loss_seed_scale: float | jax.Array = None,
+):
+    """Hand-interleaved 1F1B pipeline schedule (PipeDream-flush — the
+    reference's ``Literatures/1.1 PP/sosp_pipedream.pdf`` roadmap item, named
+    in its Final Report "Future Work"). Call under ``shard_map`` with
+    ``check_vma=True`` — the schedule runs per-tick ``jax.vjp`` INSIDE the
+    mesh program, and vma tracking is what makes collective transposes
+    (tp psums in blocks/head, sp ring-attention ppermutes) exact there.
+
+    Unlike :func:`pipeline_apply` + ``jax.grad`` (synchronous GPipe, which
+    stores one residual set per tick — O(M) activations — unless the whole
+    stage is rematerialized), 1F1B starts each microbatch's backward as soon
+    as its forward completes: in-flight activations are bounded by the
+    schedule at ≤ 2(S−1)+1 microbatch inputs per rank regardless of M, and
+    the backward recomputes the stage forward from the stashed input
+    (activation recomputation, the standard 1F1B+remat memory point). The
+    bubble fraction stays (S−1)/(M+S−1) per direction — synchronous-flush
+    1F1B trades no compute for GPipe, it trades memory.
+
+    Per rank r at tick t: forward of microbatch ``t − r`` and backward of
+    microbatch ``t − 2(S−1) + r`` (on the last stage the two coincide, so
+    its head cotangent feeds the backward the same tick — the "1F" and "1B"
+    interleave). Activations hop forward and cotangents hop backward via
+    ``ppermute`` every tick.
+
+    Arguments:
+      ``stage_fn(stage_params, x) -> y`` — this rank's stage.
+      ``head_fn(head_params, y, target) -> scalar`` — per-microbatch loss
+        (mean over its tokens); executed every tick on every rank for SPMD
+        uniformity, contributing only on the last stage.
+      ``micros`` — [M, mb, ...] pipeline inputs, replicated over the axis
+        (stage 0 consumes them). ``targets`` — [M, ...] per-micro targets.
+      ``vary_axes`` — every mesh axis the computation genuinely varies
+        over; schedule buffers are vma-lifted to this set so scan carries
+        stay type-stable.
+      ``loss_seed_scale`` — the head cotangent seed (default ``1/M``). The
+        KEY vma fact (empirically pinned by tests): the transpose of an
+        auto-lifted replicated input psums its cotangent across the lifted
+        axes IMMEDIATELY, inside each per-tick vjp. So param cotangents
+        come back already globally reduced, and the seed must carry the
+        full normalization — callers whose global loss is a mean over
+        batch axes pass ``1/(M · n_dp · n_sp)``. The seed is masked to
+        (last stage ∧ active tick), which is also what keeps inactive
+        ticks' garbage head compute OUT of those internal psums.
+
+    Returns ``(loss, d_stage, d_head, d_micros)``:
+      ``loss`` — Σ per-micro losses / M, nonzero on the last rank only
+        (caller: psum over ``axis``, pmean over batch axes).
+      ``d_stage`` / ``d_head`` — param grads, ALREADY reduced to each
+        leaf's replication (the internal-psum semantics above) under the
+        caller's seed scale; use as-is.
+      ``d_micros`` — per-rank cotangent of ``micros``, nonzero on rank 0;
+        psum over (``axis``, tensor axes) before feeding an embedding VJP.
+    """
+    n_stage = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    n_micro = micros.shape[0]
+    depth = min(n_micro, 2 * (n_stage - 1) + 1)  # max in-flight inputs per rank
+    ticks = n_micro + 2 * (n_stage - 1)
+    fwd_perm = [(i, i + 1) for i in range(n_stage - 1)]
+    bwd_perm = [(i + 1, i) for i in range(n_stage - 1)]
+    is_last = rank == n_stage - 1
+    if loss_seed_scale is None:
+        loss_seed_scale = 1.0 / n_micro
+    scale = jnp.asarray(loss_seed_scale, jnp.float32)
+
+    def tick(carry, t):
+        buf_f, buf_b, stash, g_stage, g_head, loss_acc, d_micros = carry
+        m_f = t - rank
+        m_b = t - 2 * (n_stage - 1) + rank
+        act_f = (m_f >= 0) & (m_f < n_micro)
+        act_b = (m_b >= 0) & (m_b < n_micro)
+        slot_f = jnp.clip(m_f, 0, n_micro - 1)
+        slot_b = jnp.clip(m_b, 0, n_micro - 1)
+
+        # ---- forward slot: stage 0 ingests micro m_f, others consume the
+        # previous stage's hop; the input is stashed for the backward's
+        # recompute (ring buffer of `depth` slots — never more in flight)
+        x_in = _lift(jnp.where(rank == 0, micros[slot_f], buf_f), vary_axes)
+        y = _lift(
+            jnp.where(act_f, stage_fn(stage_params, x_in), jnp.zeros_like(x_in)), vary_axes
+        )
+        stash = stash.at[slot_f % depth].set(jnp.where(act_f, x_in, stash[slot_f % depth]))
+
+        # ---- head: on the last stage, micro m_b's forward finished THIS
+        # tick (m_f == m_b there) — its loss cotangent starts the backward
+        # immediately, which is the 1F1B interleave
+        tgt = targets[slot_b]
+        l_m, head_vjp = jax.vjp(lambda hp, yy: head_fn(hp, yy, tgt), head_params, y)
+        seed = jnp.where(is_last & act_b, scale, 0.0).astype(l_m.dtype)
+        seed = _lift(seed, tuple(jax.typeof(l_m).vma))
+        d_hp, dy_head = head_vjp(seed)
+        dy = jnp.where(is_last, dy_head, buf_b)
+
+        # ---- backward slot: recompute the stage forward from the stashed
+        # input and transpose (activation recomputation — no per-tick
+        # residuals survive in the scan carry)
+        x_saved = stash[slot_b % depth]
+        y2, stage_vjp = jax.vjp(stage_fn, stage_params, x_saved)
+        dy = _lift(dy, tuple(jax.typeof(y2).vma))
+        d_sp, dx = stage_vjp(dy)
+        # d_sp / d_hp are zero on inactive ticks (the masked seed zeroes the
+        # whole cotangent chain) and already carry their internal cross-rank
+        # psums — accumulate UNMASKED, or the replicated values would be
+        # destroyed on the ranks the mask rejects
+        g_stage = jax.tree.map(jnp.add, g_stage, d_sp)
+        g_head = jax.tree.map(jnp.add, g_head, d_hp)
+        loss_acc = loss_acc + jnp.where(act_b & is_last, l_m.astype(jnp.float32), 0.0)
+        dx_masked = jnp.where(act_b, dx, jnp.zeros_like(dx))
+        d_micros = d_micros.at[slot_b].set(
+            jnp.where(act_b & (rank == 0), dx_masked, d_micros[slot_b])
+        )
+
+        buf_f = _lift(lax.ppermute(y, axis, fwd_perm), vary_axes)
+        buf_b = _lift(lax.ppermute(dx_masked, axis, bwd_perm), vary_axes)
+        return (buf_f, buf_b, stash, g_stage, g_head, loss_acc, d_micros), None
+
+    carry0 = (
+        _lift(jnp.zeros_like(micros[0]), vary_axes),  # buf_f
+        _lift(jnp.zeros_like(micros[0]), vary_axes),  # buf_b
+        _lift(jnp.zeros((depth, *micros.shape[1:]), micros.dtype), vary_axes),  # stash
+        jax.tree.map(jnp.zeros_like, stage_params),
+        jax.tree.map(jnp.zeros_like, head_params),
+        _lift(jnp.zeros((), jnp.float32), vary_axes),
+        _lift(jnp.zeros_like(micros), vary_axes),  # d_micros
+    )
+    (_, _, _, g_stage, g_head, loss_acc, d_micros), _ = lax.scan(
+        tick, carry0, jnp.arange(ticks)
+    )
+    return loss_acc / n_micro, g_stage, g_head, d_micros
