@@ -319,10 +319,13 @@ def _virtual8_main() -> None:
     finally:
         # servers must die even on failure, or their threads can outlive the
         # subprocess timeout and discard the ring/naive numbers printed below
-        if coordinator is not None:
-            coordinator.stop()
-        for d in devices:
-            d.stop()
+        # (each stop individually guarded: one bad server must not keep the
+        # rest alive or suppress the print)
+        for handle in ([coordinator] if coordinator is not None else []) + list(devices):
+            try:
+                handle.stop()
+            except Exception:
+                pass
 
     out = {
         "ring_ms": round(ring, 3),

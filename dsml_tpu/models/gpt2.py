@@ -558,7 +558,7 @@ class GPT2:
         micros = h.reshape(n_micro, b // n_micro, *h.shape[1:])
         tgt_micros = targets.reshape(n_micro, b // n_micro, *targets.shape[1:])
         vary_axes = tuple(
-            a for a in (pp_axis, *batch_axes, tp_axis, sp_axis) if a is not None
+            dict.fromkeys(a for a in (pp_axis, *batch_axes, tp_axis, sp_axis) if a is not None)
         )
         batch_ranks = 1
         for a in batch_axes:

@@ -86,6 +86,10 @@ def make_hybrid_train_step(
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     pp_size = mesh.shape.get("pp", 1)
     pp_axis = "pp" if pp_size > 1 else None
+    if schedule == "1f1b" and not pp_axis:
+        # silent fallback would let a user "measure 1F1B" on a pipeline-less
+        # mesh and actually measure the gpipe path
+        raise ValueError("schedule='1f1b' requires a mesh with pp > 1")
     pspecs = model.param_specs(pp=bool(pp_axis))
     batch_spec = P("dp", "sp")
     loss_fn = hybrid_loss_fn(model, attn_impl, pp_axis, n_microbatches)

@@ -8,15 +8,21 @@ before any backend initializes.
 from __future__ import annotations
 
 
-def configure_platform(platform: str = "", cpu_devices: int = 0) -> None:
-    """Set the jax platform ("cpu"/"tpu"/"" = container default) and, for
-    CPU, the virtual device count (0 = leave as-is)."""
+def configure_platform(
+    platform: str = "", cpu_devices: int = 0, cpu_collectives: str = ""
+) -> None:
+    """Set the jax platform ("cpu"/"tpu"/"" = container default), the CPU
+    virtual device count (0 = leave as-is), and the CPU cross-process
+    collectives backend ("gloo" for multi-process CPU clusters — required
+    before :func:`init_distributed` on CPU)."""
     import jax
 
     if platform:
         jax.config.update("jax_platforms", platform)
     if cpu_devices:
         jax.config.update("jax_num_cpu_devices", cpu_devices)
+    if cpu_collectives:
+        jax.config.update("jax_cpu_collectives_implementation", cpu_collectives)
 
 
 def init_distributed(

@@ -1,0 +1,106 @@
+"""Worker process for the multi-host (DCN-shaped) smoke test.
+
+Each worker is one "host" of a 2-process CPU cluster: it joins via
+``init_distributed`` (gloo cross-process collectives), contributes 2 local
+devices to the 4-device global mesh, and runs (a) one psum across all
+hosts and (b) two data-parallel MLP train steps where each host feeds only
+its addressable batch shard — the multi-process analogue of SURVEY.md
+§5.8's "TPU-native equivalent" (same mesh/shard_map programs, DCN traffic
+inserted by the runtime where the mesh crosses hosts).
+
+Prints one JSON line the test asserts on.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    proc_id = int(sys.argv[1])
+    port = sys.argv[2]
+
+    from dsml_tpu.utils.platform import configure_platform, init_distributed
+
+    configure_platform("cpu", 2, cpu_collectives="gloo")
+    rank = init_distributed(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=proc_id
+    )
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dsml_tpu.models.mlp import MLP
+
+    assert jax.process_index() == rank == proc_id
+    assert jax.local_device_count() == 2
+    assert jax.device_count() == 4
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    repl = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P("dp"))
+
+    # (a) cross-host psum: every device contributes (process_index + 1)
+    psum_fn = jax.jit(
+        jax.shard_map(
+            lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+            in_specs=P("dp"), out_specs=P(), check_vma=False,
+        ),
+        out_shardings=repl,
+    )
+    local = np.full((2, 1), float(proc_id + 1), np.float32)
+    shards = [jax.device_put(local[i : i + 1], d) for i, d in enumerate(jax.local_devices())]
+    x = jax.make_array_from_single_device_arrays((4, 1), row, shards)
+    psum_val = float(np.asarray(psum_fn(x).addressable_shards[0].data)[0])
+
+    # (b) DP training: each host feeds ONLY its addressable batch shard;
+    # gradient sync crosses the process boundary inside the jitted step
+    model = MLP(sizes=(16, 8, 4))
+    optimizer = optax.sgd(0.1)
+    params = jax.device_put(model.init(0), repl)
+    opt_state = jax.device_put(optimizer.init(params), repl)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        loss, grads = jax.value_and_grad(model.loss)(params, xb, yb)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.default_rng(0)  # same seed: global batch identical on both hosts
+    gx = rng.standard_normal((8, 16)).astype(np.float32)
+    gy = rng.integers(0, 4, 8).astype(np.int32)
+
+    def global_batch(arr):
+        shards = [
+            jax.device_put(arr[2 * (2 * proc_id + i) : 2 * (2 * proc_id + i + 1)], d)
+            for i, d in enumerate(jax.local_devices())
+        ]
+        return jax.make_array_from_single_device_arrays(
+            arr.shape, NamedSharding(mesh, P("dp", *[None] * (arr.ndim - 1))), shards
+        )
+
+    losses = []
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, global_batch(gx), global_batch(gy))
+        losses.append(float(np.asarray(jax.device_get(loss))))
+
+    print(
+        json.dumps(
+            {
+                "proc": proc_id,
+                "global_devices": jax.device_count(),
+                "psum": psum_val,
+                "losses": [round(l, 6) for l in losses],
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

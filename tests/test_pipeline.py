@@ -176,7 +176,6 @@ def test_1f1b_grads_match_single_device(devices8):
     psums in blocks and head, pipeline feed/head masking, tied wte."""
     from jax import lax
 
-    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
     from dsml_tpu.parallel.hybrid import shard_params
 
     mesh = build_mesh(MeshSpec(pp=2, tp=2), devices8[:4])

@@ -1,0 +1,200 @@
+"""Golden-bytes wire compatibility with the reference proto.
+
+BASELINE.json's north star says the reference's Go client "stays
+byte-for-byte identical and talks to the same proto API". No Go toolchain
+exists in this image, so compatibility is demonstrated at the wire level:
+the fixtures below are HAND-ENCODED protobuf wire bytes laid out exactly as
+protoc-gen-go would emit them for the reference's field numbers
+(``/root/reference/DSML/proto/gpu_sim.proto:170-213`` for the collective and
+memcpy messages) — tag = (field_number << 3) | wire_type, varints LEB128,
+length-delimited submessages. If ``gpu_sim_pb2`` decodes these to the right
+values AND re-encodes to the same canonical bytes, any reference-generated
+stub interoperates.
+
+Also covered: unknown-field tolerance — this repo's proto adds fields and
+RPCs (dtype on AllReduceRingRequest, ConfigurePeers, RunForward/Backward);
+a decoder built from the REFERENCE proto must be able to skip them, which
+on the wire means our messages-with-extensions parse fine through a schema
+that doesn't know the extra fields (proto3 unknown-field skipping, asserted
+here by parsing bytes carrying an unknown high-numbered field).
+"""
+
+import numpy as np
+import pytest
+
+from dsml_tpu.comm.proto import gpu_sim_pb2 as pb
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_delim(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _vint_field(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value)
+
+
+def test_comm_init_request_golden_bytes():
+    """CommInitRequest{numDevices=3, device_addresses=[…]} — the exact bytes
+    the reference client's CommInit call puts on the wire (client.go:532-539
+    with its hard-coded device ports)."""
+    addrs = ["127.0.0.1:5003", "127.0.0.1:5004", "127.0.0.1:5005"]
+    golden = _vint_field(1, 3) + b"".join(
+        _len_delim(2, a.encode()) for a in addrs
+    )
+    msg = pb.CommInitRequest()
+    msg.ParseFromString(golden)
+    assert msg.numDevices == 3
+    assert list(msg.device_addresses) == addrs
+    # canonical re-encode must reproduce the reference layout byte-for-byte
+    assert msg.SerializeToString() == golden
+
+
+def test_all_reduce_ring_request_golden_bytes():
+    """AllReduceRingRequest{commId, count, op, memAddrs} — field numbers per
+    the reference proto :170-176; memAddrs is map<uint32, MemAddr>."""
+    mem_addr_4096 = _vint_field(1, 0x1000)  # MemAddr{value=0x1000}
+    mem_addr_8192 = _vint_field(1, 0x2000)
+    entry1 = _vint_field(1, 1) + _len_delim(2, mem_addr_4096)  # {1: 0x1000}
+    entry2 = _vint_field(1, 2) + _len_delim(2, mem_addr_8192)  # {2: 0x2000}
+    golden = (
+        _vint_field(1, 7)  # commId
+        + _vint_field(2, 407_080)  # count — the reference's gradient bytes
+        + _vint_field(3, 3)  # op = MAX
+        + _len_delim(4, entry1)
+        + _len_delim(4, entry2)
+    )
+    msg = pb.AllReduceRingRequest()
+    msg.ParseFromString(golden)
+    assert msg.commId == 7
+    assert msg.count == 407_080
+    assert msg.op == pb.MAX
+    assert msg.memAddrs[1].value == 0x1000
+    assert msg.memAddrs[2].value == 0x2000
+    # map serialization order is unspecified — assert round-trip identity
+    # through a re-parse instead of byte equality
+    again = pb.AllReduceRingRequest()
+    again.ParseFromString(msg.SerializeToString())
+    assert again == msg
+
+
+def test_memcpy_h2d_request_golden_bytes():
+    """MemcpyRequest.hostToDevice — the client's weight/gradient upload
+    (client.go:204-235), oneof field 1 wrapping {bytes, DeviceId, MemAddr}."""
+    payload = np.arange(8, dtype=np.float32).tobytes()
+    inner = (
+        _len_delim(1, payload)
+        + _len_delim(2, _vint_field(1, 1))  # dstDeviceId = DeviceId{1}
+        + _len_delim(3, _vint_field(1, 0x1000))  # dstMemAddr
+    )
+    golden = _len_delim(1, inner)
+    msg = pb.MemcpyRequest()
+    msg.ParseFromString(golden)
+    assert msg.WhichOneof("either") == "hostToDevice"
+    assert msg.hostToDevice.hostSrcData == payload
+    assert msg.hostToDevice.dstDeviceId.value == 1
+    assert msg.hostToDevice.dstMemAddr.value == 0x1000
+    assert msg.SerializeToString() == golden
+
+
+def test_memcpy_d2h_request_golden_bytes():
+    """MemcpyRequest.deviceToHost — the client's gradient retrieval
+    (client.go:237-252), oneof field 2."""
+    inner = (
+        _len_delim(1, _vint_field(1, 2))  # srcDeviceId = DeviceId{2}
+        + _len_delim(2, _vint_field(1, 0x1000))  # srcMemAddr
+        + _vint_field(3, 407_080)  # numBytes
+    )
+    golden = _len_delim(2, inner)
+    msg = pb.MemcpyRequest()
+    msg.ParseFromString(golden)
+    assert msg.WhichOneof("either") == "deviceToHost"
+    assert msg.deviceToHost.srcDeviceId.value == 2
+    assert msg.deviceToHost.srcMemAddr.value == 0x1000
+    assert msg.deviceToHost.numBytes == 407_080
+    assert msg.SerializeToString() == golden
+
+
+def test_naive_all_reduce_request_golden_bytes():
+    """NaiveAllReduceRequest — the benchmark request (reference
+    allreduce_comparison_test.go:104-113: 1 MB, 10 ms latency)."""
+    golden = _vint_field(1, 7) + _vint_field(2, 1 << 20) + _vint_field(3, 10)
+    msg = pb.NaiveAllReduceRequest()
+    msg.ParseFromString(golden)
+    assert msg.commId == 7
+    assert msg.dataSize == 1 << 20
+    assert msg.latencyMs == 10
+    assert msg.SerializeToString() == golden
+
+
+def test_begin_send_request_golden_bytes():
+    """BeginSendRequest — the P2P stream handshake the coordinator issues
+    per ring step (reference gpu_coordinator_server.go:427-435)."""
+    golden = (
+        _len_delim(1, _vint_field(1, 0x1000))  # sendBuffAddr
+        + _vint_field(2, 135_694)  # numBytes (a ring segment)
+        + _len_delim(3, _vint_field(1, 2))  # dstRank = Rank{2}
+    )
+    msg = pb.BeginSendRequest()
+    msg.ParseFromString(golden)
+    assert msg.sendBuffAddr.value == 0x1000
+    assert msg.numBytes == 135_694
+    assert msg.dstRank.value == 2
+    assert msg.SerializeToString() == golden
+
+
+def test_unknown_extension_fields_are_skipped():
+    """A reference-proto decoder must tolerate this repo's additive
+    extensions. Wire-level proof: append an unknown high-numbered field
+    (as our dtype extension would appear to the reference's stubs) and
+    assert the known fields still parse identically — proto3 skips and
+    preserves unknown fields rather than erroring."""
+    base = _vint_field(1, 7) + _vint_field(2, 1024)
+    with_extension = base + _len_delim(1000, b"float32")
+    msg = pb.AllReduceRingRequest()
+    msg.ParseFromString(with_extension)
+    assert msg.commId == 7
+    assert msg.count == 1024
+    # unknown field survives a round-trip (proto3 unknown-field retention)
+    assert _len_delim(1000, b"float32") in msg.SerializeToString()
+
+
+def test_response_messages_decode_with_reference_layout():
+    """Responses the reference CLIENT decodes: CommInitResponse (success,
+    commId, devices metadata — :178-191) and NaiveAllReduceResponse
+    (totalTimeMs/totalDataTransferred metrics — :234-244)."""
+    meta = (
+        _len_delim(1, _vint_field(1, 1))  # deviceId
+        + _len_delim(2, _vint_field(1, 0x1000))  # minMemAddr
+        + _len_delim(3, _vint_field(1, 0x2000))  # maxMemAddr
+    )
+    golden = _vint_field(1, 1) + _vint_field(2, 42) + _len_delim(3, meta)
+    msg = pb.CommInitResponse()
+    msg.ParseFromString(golden)
+    assert msg.success and msg.commId == 42
+    assert msg.devices[0].deviceId.value == 1
+    assert msg.devices[0].minMemAddr.value == 0x1000
+    assert msg.devices[0].maxMemAddr.value == 0x2000
+    assert msg.SerializeToString() == golden
+
+    golden2 = _vint_field(1, 1) + _vint_field(2, 83) + _vint_field(3, 6_291_456)
+    resp = pb.NaiveAllReduceResponse()
+    resp.ParseFromString(golden2)
+    assert resp.success and resp.totalTimeMs == 83
+    assert resp.totalDataTransferred == 6_291_456  # 2 × 3 devices × 1 MB
+    assert resp.SerializeToString() == golden2
