@@ -268,7 +268,8 @@ class GPT2:
             sp_rank = lax.axis_index(sp_axis)
             pos = sp_rank * seq_local + jnp.arange(seq_local)
         else:
-            pos = jnp.arange(seq_local) + (seq_offset or 0)
+            # seq_offset may be a traced position (decode steps) — no `or`
+            pos = jnp.arange(seq_local) + (0 if seq_offset is None else seq_offset)
         if tp_axis:
             vocab_shard = params["wte"].shape[0]
             tp_rank = lax.axis_index(tp_axis)
@@ -600,14 +601,20 @@ class GPT2:
     # pre-allocated at max_seq and positions are masked, so prefill + every
     # decode step are fixed-shape XLA programs (one compile each).
 
-    def init_cache(self, batch: int) -> list:
+    def init_cache(self, batch: int, tp_size: int = 1) -> list:
+        """KV cache, pre-allocated at max_seq. Under TP the cache holds only
+        this rank's head shard — attention is head-parallel, so decode's
+        per-chip cache memory drops by tp (the point of sharded serving)."""
         cfg = self.config
+        if cfg.n_head % tp_size:
+            raise ValueError(f"n_head={cfg.n_head} not divisible by tp={tp_size}")
         hd = cfg.d_model // cfg.n_head
+        n_head_local = cfg.n_head // tp_size
         dt = jnp.dtype(cfg.dtype)
         return [
             {
-                "k": jnp.zeros((batch, cfg.n_head, cfg.max_seq, hd), dt),
-                "v": jnp.zeros((batch, cfg.n_head, cfg.max_seq, hd), dt),
+                "k": jnp.zeros((batch, n_head_local, cfg.max_seq, hd), dt),
+                "v": jnp.zeros((batch, n_head_local, cfg.max_seq, hd), dt),
             }
             for _ in range(cfg.n_layer)
         ]
@@ -630,52 +637,78 @@ class GPT2:
         b, _, s, _ = t.shape
         return t.transpose(0, 2, 1, 3).reshape(b, s, -1)
 
-    def _ffn(self, layer, h):
+    def _ffn(self, layer, h, tp_axis=None):
         if self.config.n_experts:
-            return h + self._moe_block(layer["moe"], _layer_norm(h, **layer["ln_2"]), None)
-        return h + self._mlp_block(layer["mlp"], _layer_norm(h, **layer["ln_2"]), None)
+            return h + self._moe_block(layer["moe"], _layer_norm(h, **layer["ln_2"]), tp_axis)
+        return h + self._mlp_block(layer["mlp"], _layer_norm(h, **layer["ln_2"]), tp_axis)
 
-    def prefill(self, params: dict, tokens: jax.Array):
+    def _unembed_full(self, params, h, tp_axis):
+        """h [..., d] → FULL-vocab logits. Under TP the unembedding is
+        vocab-sharded; decode needs the whole row for sampling, so the local
+        [..., vocab/tp] shards all_gather over tp (tiny at decode batch
+        sizes — [batch, vocab], not [tokens, vocab])."""
+        local = h @ params["wte"].T
+        if tp_axis:
+            return lax.all_gather(local, tp_axis, axis=-1, tiled=True)
+        return local
+
+    def prefill(self, params: dict, tokens: jax.Array, tp_axis: str | None = None):
         """Run the prompt [batch, T] in ONE pass, filling the cache.
-        Returns (last-position logits [batch, vocab], cache)."""
+        Returns (last-position logits [batch, vocab], cache).
+
+        With ``tp_axis`` (call under shard_map with Megatron-sharded
+        params), the pass is head-parallel: local-head attention + one psum
+        per block pair, vocab-sharded embed/unembed, per-rank cache shard."""
         cfg = self.config
         b, t = tokens.shape
-        h = params["wte"][tokens] + params["wpe"][jnp.arange(t)]
-        cache = self.init_cache(b)
+        tp_size = lax.axis_size(tp_axis) if tp_axis else 1
+        n_head_local = cfg.n_head // tp_size
+        h = self._embed_spmd(params, tokens, tp_axis)
+        cache = self.init_cache(b, tp_size)
         for i, layer in enumerate(params["layers"]):
             x = _layer_norm(h, **layer["ln_1"])
-            q, k, v = self._qkv_heads(layer, x)
+            q, k, v = self._qkv_heads(layer, x, n_head_local)
             out = attention(q, k, v, causal=True)
-            h = h + self._merge_heads(out) @ layer["attn"]["wo"] + layer["attn"]["bo"]
-            h = self._ffn(layer, h)
+            attn_out = self._merge_heads(out) @ layer["attn"]["wo"]
+            if tp_axis:
+                attn_out = lax.psum(attn_out, tp_axis)
+            h = h + attn_out + layer["attn"]["bo"]
+            h = self._ffn(layer, h, tp_axis)
             cache[i] = {
                 "k": lax.dynamic_update_slice(cache[i]["k"], k, (0, 0, 0, 0)),
                 "v": lax.dynamic_update_slice(cache[i]["v"], v, (0, 0, 0, 0)),
             }
         h = _layer_norm(h, **params["ln_f"])
-        return h[:, -1] @ params["wte"].T, cache
+        return self._unembed_full(params, h[:, -1], tp_axis), cache
 
-    def decode_step(self, params: dict, cache: list, tokens: jax.Array, pos: jax.Array):
+    def decode_step(
+        self, params: dict, cache: list, tokens: jax.Array, pos: jax.Array,
+        tp_axis: str | None = None,
+    ):
         """One decode step: ``tokens`` [batch] at position ``pos`` (scalar).
         Returns (logits [batch, vocab], updated cache)."""
         cfg = self.config
-        b = tokens.shape[0]
-        h = params["wte"][tokens][:, None, :] + params["wpe"][pos][None, None, :]
+        tp_size = lax.axis_size(tp_axis) if tp_axis else 1
+        n_head_local = cfg.n_head // tp_size
+        h = self._embed_spmd(params, tokens[:, None], tp_axis, seq_offset=pos)
         valid = jnp.arange(cfg.max_seq) <= pos  # attend to cache[0..pos]
         new_cache = []
         for layer, c in zip(params["layers"], cache):
             x = _layer_norm(h, **layer["ln_1"])
-            q, k, v = self._qkv_heads(layer, x)  # [b, H, 1, hd]
+            q, k, v = self._qkv_heads(layer, x, n_head_local)  # [b, H_local, 1, hd]
             ck = lax.dynamic_update_slice(c["k"], k, (0, 0, pos, 0))
             cv = lax.dynamic_update_slice(c["v"], v, (0, 0, pos, 0))
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck) * (q.shape[-1] ** -0.5)
             scores = jnp.where(valid[None, None, None, :], scores, _NEG_INF)
             out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), cv)
-            h = h + self._merge_heads(out) @ layer["attn"]["wo"] + layer["attn"]["bo"]
-            h = self._ffn(layer, h)
+            attn_out = self._merge_heads(out) @ layer["attn"]["wo"]
+            if tp_axis:
+                attn_out = lax.psum(attn_out, tp_axis)
+            h = h + attn_out + layer["attn"]["bo"]
+            h = self._ffn(layer, h, tp_axis)
             new_cache.append({"k": ck, "v": cv})
         h = _layer_norm(h, **params["ln_f"])
-        return h[:, 0] @ params["wte"].T, new_cache
+        return self._unembed_full(params, h[:, 0], tp_axis), new_cache
 
     def generate(
         self,
@@ -691,8 +724,13 @@ class GPT2:
         greedy; otherwise softmax sampling, optionally truncated to the
         ``top_k`` most likely tokens and/or the nucleus holding ``top_p``
         probability mass. Returns [batch, max_new_tokens]."""
+        t = prompt.shape[1]
+        self._check_generate_args(t, max_new_tokens, temperature, top_k, top_p)
+        run = self._generate_fn(t, max_new_tokens, float(temperature), int(top_k), float(top_p))
+        return run(params, prompt.astype(jnp.int32), jax.random.PRNGKey(seed))
+
+    def _check_generate_args(self, t, max_new_tokens, temperature, top_k, top_p):
         cfg = self.config
-        b, t = prompt.shape
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         if t + max_new_tokens > cfg.max_seq:
@@ -705,18 +743,65 @@ class GPT2:
             raise ValueError(f"top_p must be in [0, 1], got {top_p}")
         if temperature < 0.0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
-        run = self._generate_fn(t, max_new_tokens, float(temperature), int(top_k), float(top_p))
+
+    def generate_spmd(
+        self,
+        params: dict,
+        prompt: jax.Array,
+        max_new_tokens: int,
+        mesh,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 0.0,
+        seed: int = 0,
+    ) -> jax.Array:
+        """TP-sharded serving: :meth:`generate` with Megatron-sharded params
+        over the mesh's ``tp`` axis (``shard_params(model.param_specs())``
+        placement). Head-parallel prefill/decode with a per-rank KV-cache
+        shard; every rank reconstructs the full logits row (vocab-shard
+        all_gather) and runs the identical sampler with the identical key,
+        so the tokens match the single-device path exactly (tests pin it).
+        The reference has no inference at all — this is the serving shape a
+        125M+ flagship needs."""
+        b, t = prompt.shape
+        self._check_generate_args(t, max_new_tokens, temperature, top_k, top_p)
+        tp_size = mesh.shape.get("tp", 1)
+        if self.config.n_head % tp_size:
+            raise ValueError(f"n_head={self.config.n_head} not divisible by tp={tp_size}")
+        from jax.sharding import PartitionSpec as P
+
+        key_ = ("spmd", mesh, t, max_new_tokens, float(temperature), int(top_k), float(top_p))
+        cache = self._gen_cache_dict()
+        run = cache.get(key_)
+        if run is None:
+            raw = self._generate_fn(
+                t, max_new_tokens, float(temperature), int(top_k), float(top_p),
+                tp_axis="tp", jit=False,
+            )
+            run = jax.jit(
+                jax.shard_map(
+                    raw, mesh=mesh,
+                    in_specs=(self.param_specs(), P(), P()),
+                    out_specs=P(), check_vma=False,
+                )
+            )
+            cache[key_] = run
         return run(params, prompt.astype(jnp.int32), jax.random.PRNGKey(seed))
 
-    def _generate_fn(
-        self, prompt_len: int, max_new_tokens: int, temperature: float, top_k: int, top_p: float = 0.0
-    ):
-        """Compiled generate program, cached per (prompt_len, max_new,
-        temperature, top_k, top_p) so repeated serving calls don't re-trace."""
-        key_ = (prompt_len, max_new_tokens, temperature, top_k, top_p)
+    def _gen_cache_dict(self) -> dict:
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
             cache = self._gen_cache = {}
+        return cache
+
+    def _generate_fn(
+        self, prompt_len: int, max_new_tokens: int, temperature: float, top_k: int,
+        top_p: float = 0.0, tp_axis: str | None = None, jit: bool = True,
+    ):
+        """Compiled generate program, cached per (prompt_len, max_new,
+        temperature, top_k, top_p) so repeated serving calls don't re-trace."""
+        key_ = (prompt_len, max_new_tokens, temperature, top_k, top_p, tp_axis, jit)
+        cache = self._gen_cache_dict()
         if key_ in cache:
             return cache[key_]
 
@@ -741,15 +826,14 @@ class GPT2:
                 logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
             return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
-        @jax.jit
         def run(params, prompt, key):
-            logits, kv = self.prefill(params, prompt)
+            logits, kv = self.prefill(params, prompt, tp_axis)
             key, sub = jax.random.split(key)
             first = sample(logits, sub)
 
             def body(carry, _):
                 kv, tok, pos, key = carry
-                logits, kv = self.decode_step(params, kv, tok, pos)
+                logits, kv = self.decode_step(params, kv, tok, pos, tp_axis)
                 key, sub = jax.random.split(key)
                 nxt = sample(logits, sub)
                 return (kv, nxt, pos + 1, key), nxt
@@ -758,5 +842,7 @@ class GPT2:
             _, rest = lax.scan(body, carry, None, length=max_new_tokens - 1)
             return jnp.concatenate([first[None], rest], axis=0).T  # [b, max_new]
 
+        if jit:
+            run = jax.jit(run)
         cache[key_] = run
         return run

@@ -42,12 +42,18 @@ def test_two_process_cluster_psum_and_dp_training():
         for i in range(2)
     ]
     results = {}
-    for p in procs:
-        out, err = p.communicate(timeout=240)
-        assert p.returncode == 0, f"worker failed:\nstdout={out}\nstderr={err[-2000:]}"
-        line = [l for l in out.strip().splitlines() if l.startswith("{")][-1]
-        r = json.loads(line)
-        results[r["proc"]] = r
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, f"worker failed:\nstdout={out}\nstderr={err[-2000:]}"
+            line = [l for l in out.strip().splitlines() if l.startswith("{")][-1]
+            r = json.loads(line)
+            results[r["proc"]] = r
+    finally:
+        # a dead worker must not orphan its peer blocked in the init barrier
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
     assert set(results) == {0, 1}
     for r in results.values():
