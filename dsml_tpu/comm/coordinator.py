@@ -176,7 +176,15 @@ class CoordinatorRuntime:
         return comm
 
     def comm_status(self, comm_id: int) -> int:
-        return self._get_comm(comm_id).status
+        return self.comm_members(comm_id)[0]
+
+    def comm_members(self, comm_id: int) -> tuple[int, list[tuple[int, int, str]]]:
+        """(status, [(rank, device_id, address)…]) — the CURRENT membership,
+        which elastic recovery may have renumbered; clients re-resolve their
+        rank→device maps from this instead of holding stale CommInit ranks."""
+        comm = self._get_comm(comm_id)
+        with comm.lock:
+            return comm.status, [(i.rank, i.device_id, i.address) for i in comm.devices]
 
     def comm_destroy(self, comm_id: int) -> None:
         comm = self._get_comm(comm_id)
@@ -511,9 +519,9 @@ class CoordinatorRuntime:
                 # the OLD rank tables and must fail on the dead device, not
                 # get misrouted to a renumbered survivor), (3) only then push
                 # the new peer tables device-side and swap coordinator state.
-                # NOTE: server-side recovery only — clients addressing
-                # per-rank memAddrs must re-resolve ranks (or re-CommInit)
-                # after a non-tail failure.
+                # Clients re-resolve their rank→device maps afterwards via
+                # GetCommStatus's members extension
+                # (PipelineClient.refresh_membership).
                 with comm.lock:
                     comm.status = pb.FAILED
                 deadline = time.monotonic() + self.config.probe_timeout_s
@@ -580,10 +588,16 @@ class CoordinatorServicer:
 
     def GetCommStatus(self, request, context):  # noqa: N802
         try:
-            status = self.rt.comm_status(request.commId)
+            status, members = self.rt.comm_members(request.commId)
         except DeviceError as e:
             self._abort(context, e)
-        return pb.GetCommStatusResponse(status=status)
+        return pb.GetCommStatusResponse(
+            status=status,
+            members=[
+                pb.CommMember(rank=r, deviceId=pb.DeviceId(value=d), address=a)
+                for r, d, a in members
+            ],
+        )
 
     def CommDestroy(self, request, context):  # noqa: N802
         try:
