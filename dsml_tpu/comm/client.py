@@ -54,7 +54,7 @@ class PipelineClient:
             [m.deviceId.value for m in resp.devices], list(device_addrs),
         )
 
-    def refresh_membership(self, timeout: float = 5.0) -> int:
+    def refresh_membership(self, timeout: float = 5.0, expect_change: bool = False) -> int:
         """Re-resolve rank→device from the coordinator's CURRENT view.
 
         After elastic recovery renumbers survivors, the client's per-rank
@@ -62,30 +62,45 @@ class PipelineClient:
         all; VERDICT r1 flagged the stale-client half). GetCommStatus's
         additive ``members`` extension carries (rank, deviceId, address);
         rebuild the stub table in rank order, reusing live channels by
-        address. Returns the new communicator size.
+        address (closing replaced ones). Returns the new communicator size.
 
-        While the comm reports FAILED the old table may still be installed
-        (recovery drains in-flight collectives before renumbering), so poll
-        until the status clears; a comm still FAILED at the deadline has no
-        recovered membership to install — raise instead of silently keeping
-        stale ranks."""
+        Polls past two windows: while the comm reports FAILED the old table
+        may still be installed (recovery drains in-flight collectives before
+        renumbering) — a comm still FAILED at the deadline raises rather
+        than silently keeping stale ranks. And with ``expect_change=True``
+        (use after a per-rank RPC error), also poll until the membership
+        actually DIFFERS from the client's current table — the coordinator's
+        health probe may simply not have noticed the failure yet."""
         import time
 
+        current = list(zip(self.device_ids, self.addresses or []))
         deadline = time.monotonic() + timeout
         while True:
             resp = self.coordinator.GetCommStatus(
                 pb.GetCommStatusRequest(commId=self.comm_id), timeout=timeout
             )
-            if resp.status != pb.FAILED:
+            fresh = [(m.deviceId.value, m.address) for m in sorted(resp.members, key=lambda m: m.rank)]
+            if resp.status != pb.FAILED and not (expect_change and fresh == current):
                 break
             if time.monotonic() >= deadline:
+                if resp.status == pb.FAILED:
+                    raise RuntimeError(
+                        f"communicator {self.comm_id} still FAILED after {timeout}s; "
+                        "membership not refreshed (re-CommInit required)"
+                    )
                 raise RuntimeError(
-                    f"communicator {self.comm_id} still FAILED after {timeout}s; "
-                    "membership not refreshed (re-CommInit required)"
+                    f"communicator {self.comm_id} membership unchanged after {timeout}s; "
+                    "the coordinator has not (yet) observed the expected failure"
                 )
             time.sleep(0.05)
         members = sorted(resp.members, key=lambda m: m.rank)
         by_addr = dict(zip(self.addresses or [], self.devices))
+        keep = {m.address for m in members}
+        for addr, stub in by_addr.items():
+            if addr not in keep:  # mirror the coordinator's channel hygiene
+                channel = getattr(stub, "_channel", None)
+                if channel is not None:
+                    channel.close()
         self.devices = [
             by_addr.get(m.address) or rpc.device_stub(grpc.insecure_channel(m.address))
             for m in members

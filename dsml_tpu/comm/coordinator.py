@@ -175,9 +175,6 @@ class CoordinatorRuntime:
             raise DeviceError(grpc.StatusCode.NOT_FOUND, f"unknown communicator {comm_id}")
         return comm
 
-    def comm_status(self, comm_id: int) -> int:
-        return self.comm_members(comm_id)[0]
-
     def comm_members(self, comm_id: int) -> tuple[int, list[tuple[int, int, str]]]:
         """(status, [(rank, device_id, address)…]) — the CURRENT membership,
         which elastic recovery may have renumbered; clients re-resolve their

@@ -68,6 +68,7 @@ class _Stub:
     """Client stub: one callable per RPC, matching generated-stub ergonomics."""
 
     def __init__(self, channel: grpc.Channel, service_name: str):
+        self._channel = channel  # retained so owners can close() on replace
         for name, (arity, req_cls, resp_cls) in _SERVICES[service_name].items():
             path = f"/{service_name}/{name}"
             if arity == "uu":

@@ -391,8 +391,8 @@ def flash_attention_lse(
     causal: bool = True,
     q_start: jax.Array | int = 0,
     k_start: jax.Array | int = 0,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: bool | None = None,
 ):
     """Flash attention returning ``(out, lse)``. Shapes: q/k/v
@@ -421,8 +421,8 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Flash attention. Shapes: [batch, heads, seq, head_dim].
@@ -449,8 +449,8 @@ def ring_flash_attention(
     v: jax.Array,
     axis_name: str,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
 ) -> jax.Array:
     """Ring attention with a flash kernel per hop (call under ``shard_map``).
 

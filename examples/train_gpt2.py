@@ -45,6 +45,7 @@ class GPT2TrainConfig(Config):
     seq_len: int = field(0, help="sequence length (0 = model max)")
     grad_accum: int = field(2, help="gradient-accumulation microbatches per step")
     pp: int = field(1, help="pipeline-parallel stages")
+    schedule: str = field("gpipe", help="pipeline schedule (pp > 1): gpipe | 1f1b")
     n_micro: int = field(2, help="pipeline microbatches per step (pp > 1)")
     dp: int = field(0, help="data-parallel size (0 = derive from devices)")
     sp: int = field(1, help="sequence-parallel size")
@@ -180,7 +181,7 @@ def main(argv=None):
     )
     step = make_hybrid_train_step(
         model, optimizer, mesh, attn_impl=cfg.attn, grad_accum=cfg.grad_accum,
-        n_microbatches=n_micro,
+        n_microbatches=n_micro, schedule=cfg.schedule,
     )
     params, opt_state = init_hybrid(model, optimizer, mesh, seed=cfg.seed)
     if ckpt is not None and start_step > 0:

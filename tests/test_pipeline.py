@@ -240,3 +240,29 @@ def test_1f1b_converges_with_moe(devices8):
         first = float(loss) if first is None else first
         last = float(loss)
     assert last < first - 0.2, (first, last)
+
+
+def test_1f1b_activation_memory_flat_in_microbatches(devices8):
+    """The schedule's reason to exist: GPipe-via-jax.grad stores one
+    residual set per tick (activation memory grows with M), 1F1B bounds
+    in-flight activations by the schedule and recomputes. Pin it with the
+    compiler's own accounting: at M=16 microbatches the 1F1B step's temp
+    memory must be several times smaller (measured ~12× on this config)."""
+    import optax
+
+    from dsml_tpu.parallel.hybrid import init_hybrid, make_hybrid_train_step
+
+    mesh = build_mesh(MeshSpec(pp=2, dp=2, sp=2), devices8)
+    model, _, _ = _gpt2_tiny_batch()
+    M = 16
+    rng = np.random.default_rng(12)
+    x = rng.integers(0, model.config.vocab_size, (2 * M * 2, model.config.max_seq)).astype(np.int32)
+    y = np.roll(x, -1, 1).astype(np.int32)
+    temps = {}
+    for sched in ("gpipe", "1f1b"):
+        opt = optax.sgd(0.1)
+        step = make_hybrid_train_step(model, opt, mesh, n_microbatches=M, schedule=sched)
+        params, ostate = init_hybrid(model, opt, mesh, seed=5)
+        ma = step.lower(params, ostate, x, y).compile().memory_analysis()
+        temps[sched] = ma.temp_size_in_bytes
+    assert temps["1f1b"] * 4 < temps["gpipe"], temps
