@@ -73,13 +73,22 @@ class PipelineClient:
         health probe may simply not have noticed the failure yet."""
         import time
 
-        current = list(zip(self.device_ids, self.addresses or []))
+        # addresses may be unknown (directly-constructed client): fall back
+        # to device-id comparison so expect_change still means something
+        if self.addresses:
+            current = list(zip(self.device_ids, self.addresses))
+        else:
+            current = list(self.device_ids)
         deadline = time.monotonic() + timeout
         while True:
             resp = self.coordinator.GetCommStatus(
                 pb.GetCommStatusRequest(commId=self.comm_id), timeout=timeout
             )
-            fresh = [(m.deviceId.value, m.address) for m in sorted(resp.members, key=lambda m: m.rank)]
+            ordered = sorted(resp.members, key=lambda m: m.rank)
+            if self.addresses:
+                fresh = [(m.deviceId.value, m.address) for m in ordered]
+            else:
+                fresh = [m.deviceId.value for m in ordered]
             if resp.status != pb.FAILED and not (expect_change and fresh == current):
                 break
             if time.monotonic() >= deadline:

@@ -76,7 +76,7 @@ def _scratch(shape):
 
 
 def _pick_block(seq: int, preferred: int) -> int | None:
-    for b in (preferred, 128, 64, 32, 16, 8):
+    for b in (preferred, 256, 128, 64, 32, 16, 8):
         if b <= preferred and seq % b == 0:
             return b
     return None

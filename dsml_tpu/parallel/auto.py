@@ -1,0 +1,149 @@
+"""Automatic parallelism planning — pick the mesh for a model + fleet.
+
+The reference carries auto-parallelism as literature only (Alpa,
+``Literatures/4. Auto P/osdi22-zheng-lianmin.pdf`` — ILP over intra-op
+shardings + DP over pipeline splits; SURVEY.md §2.3). A full ILP search is
+out of scope here (and XLA's own auto-SPMD partitioner is the in-compiler
+version of it); what a framework user actually needs first is the
+*inter-op* decision Alpa's outer loop makes: which parallelism axes to use
+at all, given the model and the chips. :func:`plan_mesh` makes that call
+deterministically from first-order memory/communication arithmetic and
+returns a :class:`MeshSpec` that drops straight into
+``build_mesh`` + ``make_hybrid_train_step``.
+
+The rules (each one is the standard capacity argument, documented inline):
+
+1. Training state per replica ≈ params × (bytes(dtype) for weights +
+   2×bytes for grads... conservatively ``dtype + grad + 2×f32 adam`` ≈
+   12 bytes at bf16). If that fits in a fraction of one chip's HBM →
+   pure DP (cheapest comm: one grad all-reduce).
+2. If not, shard the state: prefer FSDP (params/grads/opt sharded over
+   the whole fleet; communication = all-gather weights + reduce-scatter
+   grads, overlappable) until per-chip state fits.
+3. TP only when a single LAYER's working set is too big for a chip or the
+   caller asks for lower latency than FSDP gathers allow — bounded by
+   head divisibility.
+4. SP (ring attention) when the per-chip ACTIVATION footprint of the
+   sequence — seq × d × layers × bytes — crosses the budget; ring hops
+   are cheap next to attention FLOPs at that point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from dsml_tpu.parallel.mesh import MeshSpec
+
+__all__ = ["plan_mesh", "AutoPlan"]
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoPlan:
+    spec: MeshSpec
+    reasons: tuple[str, ...]  # one line per decision, in decision order
+
+
+def _divisors_desc(n: int, limit: int) -> list[int]:
+    return [d for d in range(min(n, limit), 0, -1) if n % d == 0]
+
+
+def plan_mesh(
+    n_devices: int,
+    n_params: int,
+    n_head: int | None = None,
+    seq_len: int = 0,
+    d_model: int = 0,
+    n_layer: int = 0,
+    batch_per_device: int = 1,
+    param_bytes: int = 2,
+    hbm_bytes: float = 16e9,
+    hbm_budget: float = 0.6,
+) -> AutoPlan:
+    """Choose (pp, dp, fsdp, sp, tp) for ``n_devices`` chips.
+
+    ``param_bytes`` — weight dtype width (2 = bf16). ``hbm_bytes`` — per-chip
+    HBM (v5e default). ``hbm_budget`` — fraction of HBM the plan may assume
+    for state + activations (the rest is XLA workspace/fragmentation).
+
+    Returns the spec plus human-readable reasons, so the decision is
+    auditable rather than oracular.
+    """
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    budget = hbm_bytes * hbm_budget
+    # weights + grads at param dtype, adam m/v at f32
+    state_bytes = n_params * (2 * param_bytes + 8)
+    reasons: list[str] = []
+
+    remaining = n_devices
+    tp = 1
+    fsdp = 1
+    sp = 1
+
+    if state_bytes <= budget:
+        reasons.append(
+            f"training state {state_bytes/1e9:.2f} GB fits one chip's "
+            f"{budget/1e9:.1f} GB budget → replicate (pure DP)"
+        )
+    else:
+        need = -(-int(state_bytes) // int(budget))  # ceil shards needed
+        if need <= remaining:
+            # fsdp alone can fit the state: smallest divisor covering the
+            # need, leaving the rest for dp (rule 2)
+            fsdp = min(c for c in _divisors_desc(remaining, remaining) if c >= need)
+            reasons.append(
+                f"training state {state_bytes/1e9:.2f} GB > budget → fsdp={fsdp} "
+                f"(per-chip shard {state_bytes/fsdp/1e9:.2f} GB)"
+            )
+            remaining //= fsdp
+        else:
+            # even fsdp over every chip can't fit a shard: add TP, bounded
+            # by head divisibility, and give fsdp everything left (rule 3).
+            # tp×fsdp covers the same chips whatever the split, so take the
+            # SMALLEST tp > 1 — per-layer all-reduces stay narrow and fsdp
+            # (cheaper, overlappable comm) carries the rest
+            if n_head:
+                tp = min(
+                    (c for c in _divisors_desc(remaining, n_head) if c > 1 and n_head % c == 0),
+                    default=1,
+                )
+            if tp > 1:
+                remaining //= tp
+                reasons.append(
+                    f"state needs {need} shards > {n_devices} chips → add tp={tp} "
+                    f"(smallest head-divisible split; n_head={n_head})"
+                )
+            fsdp = remaining
+            remaining = 1
+            reasons.append(
+                f"fsdp={fsdp} over all remaining chips (best effort: per-chip "
+                f"shard {state_bytes/fsdp/max(tp,1)/1e9:.2f} GB still exceeds "
+                f"the budget — more chips or a smaller model needed)"
+                if state_bytes / fsdp / max(tp, 1) > budget
+                else f"fsdp={fsdp} over all remaining chips"
+            )
+
+    # activations: per-device batch × seq × d × ~20 tensors/layer × layers
+    if seq_len and d_model and n_layer:
+        act_bytes = batch_per_device * seq_len * d_model * n_layer * 20 * param_bytes
+        if act_bytes > 0.5 * budget and remaining > 1:
+            # smallest sufficient split — the rest stays with dp
+            sp = min(
+                (c for c in _divisors_desc(remaining, remaining) if act_bytes / c <= 0.5 * budget),
+                default=remaining,
+            )
+            if sp > 1:
+                remaining //= sp
+                reasons.append(
+                    f"sequence activations {act_bytes/1e9:.2f} GB > half-budget → "
+                    f"sp={sp} (ring attention shards the sequence)"
+                )
+
+    dp = remaining
+    if dp > 1:
+        reasons.append(f"remaining {dp} devices → dp={dp}")
+    spec = MeshSpec(pp=1, dp=dp, fsdp=fsdp, sp=sp, tp=tp)
+    total = dp * fsdp * sp * tp
+    if total != n_devices:
+        raise AssertionError(f"planned {total} devices for {n_devices}")  # pragma: no cover
+    return AutoPlan(spec=spec, reasons=tuple(reasons))
