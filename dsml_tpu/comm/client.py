@@ -102,21 +102,24 @@ class PipelineClient:
                     "the coordinator has not (yet) observed the expected failure"
                 )
             time.sleep(0.05)
-        members = sorted(resp.members, key=lambda m: m.rank)
         by_addr = dict(zip(self.addresses or [], self.devices))
-        keep = {m.address for m in members}
-        for addr, stub in by_addr.items():
-            if addr not in keep:  # mirror the coordinator's channel hygiene
+        new_devices = [
+            by_addr.get(m.address) or rpc.device_stub(grpc.insecure_channel(m.address))
+            for m in ordered
+        ]
+        # channel hygiene (mirrors the coordinator's): close every old stub
+        # that was NOT carried over — including the addresses-unknown case,
+        # where nothing can be matched and ALL old channels are replaced
+        reused = {id(s) for s in new_devices}
+        for stub in self.devices:
+            if id(stub) not in reused:
                 channel = getattr(stub, "_channel", None)
                 if channel is not None:
                     channel.close()
-        self.devices = [
-            by_addr.get(m.address) or rpc.device_stub(grpc.insecure_channel(m.address))
-            for m in members
-        ]
-        self.device_ids = [m.deviceId.value for m in members]
-        self.addresses = [m.address for m in members]
-        return len(members)
+        self.devices = new_devices
+        self.device_ids = [m.deviceId.value for m in ordered]
+        self.addresses = [m.address for m in ordered]
+        return len(ordered)
 
     # ---- per-device data movement ---------------------------------------------
 

@@ -354,14 +354,19 @@ class GPT2:
             # silently-wrong block-diagonal attention — route it to ring.
             if attn_impl == "ulysses":
                 out = ulysses_attention(q, k, v, sp_axis, causal=True)
+            elif attn_impl == "ulysses_flash":
+                out = ulysses_attention(q, k, v, sp_axis, causal=True, flash=True)
             elif attn_impl == "ring_flash":
                 from dsml_tpu.ops.flash import ring_flash_attention
 
                 out = ring_flash_attention(q, k, v, sp_axis, causal=True)
             else:
                 out = ring_attention(q, k, v, sp_axis, causal=True)
-        elif attn_impl in ("flash", "ring_flash"):
-            # no sp axis → ring_flash degenerates to the single-chip kernel
+        elif attn_impl in ("flash", "ring_flash", "ulysses_flash"):
+            # no sp axis → every flash variant degenerates to the
+            # single-chip kernel (falling through to plain attention would
+            # materialize the [seq, seq] scores the caller chose flash to
+            # avoid)
             from dsml_tpu.ops.flash import flash_attention
 
             out = flash_attention(q, k, v, causal=True)

@@ -122,20 +122,30 @@ def _check_head_split(q, n):
 
 
 def ulysses_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str, causal: bool = True
+    q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str, causal: bool = True,
+    flash: bool = False,
 ) -> jax.Array:
     """Exact attention via all-to-all head/sequence re-sharding.
 
     Enter with sequence-sharded blocks [batch, heads, seq/n, head_dim];
     one all-to-all flips to head-sharded full sequences
-    [batch, heads/n, seq, head_dim], plain attention runs locally, a second
+    [batch, heads/n, seq, head_dim], attention runs locally, a second
     all-to-all flips back. Requires heads % axis_size == 0.
+
+    ``flash=True`` runs the local attention through the Pallas flash kernel
+    (``ops.flash``) — after the re-shard each rank holds the FULL sequence
+    for its head group, so this is where the [seq, seq] score matrix would
+    otherwise materialize; flash keeps it at O(block²) VMEM.
     """
     n = lax.axis_size(axis_name)
+    if flash:
+        from dsml_tpu.ops.flash import flash_attention as attn_fn
+    else:
+        attn_fn = attention
     if n == 1:
-        return attention(q, k, v, causal)
+        return attn_fn(q, k, v, causal)
     _check_head_split(q, n)
-    out = attention(
+    out = attn_fn(
         _seq_to_heads(q, axis_name), _seq_to_heads(k, axis_name), _seq_to_heads(v, axis_name), causal
     )
     return _heads_to_seq(out, axis_name)
@@ -148,6 +158,7 @@ def attention_2d(
     inner_axis: str,
     outer_axis: str,
     causal: bool = True,
+    flash: bool = False,
 ) -> jax.Array:
     """LoongTrain-style 2D attention: head-parallel inner × context-parallel
     outer grid (SURVEY.md §5.7, ``Literatures/2.Sequence Parallelism/
@@ -163,12 +174,20 @@ def attention_2d(
     flat ring over all devices. A second all-to-all restores the layout.
 
     Requires ``heads % inner_axis_size == 0``; exact for any causal/full mask.
+    ``flash=True`` runs the outer ring with one Pallas flash call per hop
+    (``ops.flash.ring_flash_attention``).
     """
+    if flash:
+        from dsml_tpu.ops.flash import ring_flash_attention
+
+        ring_fn = ring_flash_attention
+    else:
+        ring_fn = ring_attention
     n_inner = lax.axis_size(inner_axis)
     if n_inner == 1:
-        return ring_attention(q, k, v, outer_axis, causal)
+        return ring_fn(q, k, v, outer_axis, causal)
     _check_head_split(q, n_inner)
-    out = ring_attention(
+    out = ring_fn(
         _seq_to_heads(q, inner_axis),
         _seq_to_heads(k, inner_axis),
         _seq_to_heads(v, inner_axis),

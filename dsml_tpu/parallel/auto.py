@@ -70,7 +70,11 @@ def plan_mesh(
     """
     if n_devices < 1:
         raise ValueError(f"n_devices must be >= 1, got {n_devices}")
-    budget = hbm_bytes * hbm_budget
+    # disjoint pools so state + activations can never be double-promised
+    # against the same bytes: 2/3 of the budget for training state, 1/3 for
+    # activations
+    budget = hbm_bytes * hbm_budget * 2 / 3
+    act_budget = hbm_bytes * hbm_budget / 3
     # weights + grads at param dtype, adam m/v at f32
     state_bytes = n_params * (2 * param_bytes + 8)
     reasons: list[str] = []
@@ -126,17 +130,25 @@ def plan_mesh(
     # activations: per-device batch × seq × d × ~20 tensors/layer × layers
     if seq_len and d_model and n_layer:
         act_bytes = batch_per_device * seq_len * d_model * n_layer * 20 * param_bytes
-        if act_bytes > 0.5 * budget and remaining > 1:
+        if act_bytes > act_budget and remaining > 1:
             # smallest sufficient split — the rest stays with dp
             sp = min(
-                (c for c in _divisors_desc(remaining, remaining) if act_bytes / c <= 0.5 * budget),
+                (c for c in _divisors_desc(remaining, remaining) if act_bytes / c <= act_budget),
                 default=remaining,
             )
             if sp > 1:
                 remaining //= sp
+                shard = act_bytes / sp
                 reasons.append(
-                    f"sequence activations {act_bytes/1e9:.2f} GB > half-budget → "
-                    f"sp={sp} (ring attention shards the sequence)"
+                    f"sequence activations {act_bytes/1e9:.2f} GB > "
+                    f"{act_budget/1e9:.1f} GB activation budget → sp={sp} "
+                    f"(ring attention shards the sequence)"
+                    + (
+                        f" — best effort: {shard/1e9:.2f} GB/chip still exceeds the "
+                        "budget; more chips or remat needed"
+                        if shard > act_budget
+                        else ""
+                    )
                 )
 
     dp = remaining

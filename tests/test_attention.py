@@ -126,3 +126,63 @@ def test_ulysses_requires_divisible_heads(mesh8):
                 mesh=mesh8, in_specs=(spec,), out_specs=spec, check_vma=False,
             )
         )(q)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_flash_matches_full(mesh8, causal):
+    """Ulysses with the Pallas flash inner kernel (interpret mode on CPU)
+    must equal full attention — the long-context Ulysses path."""
+    q, k, v = _qkv(4)
+    expected = np.asarray(attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal))
+    got = _run_sp(
+        mesh8, lambda q, k, v: ulysses_attention(q, k, v, "dev", causal, flash=True), q, k, v
+    )
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
+
+
+def test_attention_2d_flash_matches_full(devices8):
+    """2D attention with flash ring hops on the outer axis."""
+    from jax.sharding import Mesh
+
+    from dsml_tpu.ops.attention import attention_2d
+
+    mesh = Mesh(np.asarray(devices8).reshape(2, 4), ("o", "i"))
+    q, k, v = _qkv(5)
+    expected = np.asarray(attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), True))
+    spec = P(None, None, ("o", "i"), None)
+    wrapped = jax.shard_map(
+        lambda q, k, v: attention_2d(q, k, v, "i", "o", True, flash=True),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
+    )
+    got = np.asarray(jax.jit(wrapped)(q, k, v))
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
+
+
+def test_gpt2_ulysses_flash_loss_matches(devices8):
+    """attn_impl='ulysses_flash' through the hybrid loss equals single
+    device."""
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.parallel.hybrid import hybrid_loss_fn, shard_params
+    from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(sp=2, tp=2), devices8[:4])
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(1)
+    rng = np.random.default_rng(2)
+    x = rng.integers(0, cfg.vocab_size, (4, cfg.max_seq)).astype(np.int32)
+    y = np.roll(x, -1, 1).astype(np.int32)
+    expected = float(jax.jit(model.loss)(params, x, y))
+    loss_fn = hybrid_loss_fn(model, "ulysses_flash")
+    sharded = jax.jit(
+        jax.shard_map(
+            lambda p, xx, yy: jax.lax.pmean(loss_fn(p, xx, yy), ("dp", "sp")),
+            mesh=mesh,
+            in_specs=(model.param_specs(), P("dp", "sp"), P("dp", "sp")),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    placed = shard_params(params, mesh, model.param_specs())
+    got = float(sharded(placed, x, y))
+    assert np.isclose(got, expected, rtol=5e-4), (got, expected)
