@@ -96,7 +96,54 @@ def bench_gpt2() -> dict:
         )
     except Exception as e:
         out["gpt2_seq8k_error"] = repr(e)[:200]
+    # serving row: greedy KV-cache decode throughput (the reference has no
+    # inference path at all)
+    try:
+        out.update(bench_gpt2_decode())
+    except Exception as e:
+        out["gpt2_decode_error"] = repr(e)[:200]
     return out
+
+
+def bench_gpt2_decode() -> dict:
+    """Greedy decode tokens/sec on the compiled prefill + KV-cache path:
+    batch 8, prompt 128. Timing by differencing a long and a short generate
+    (same prefill, same dispatch+fetch overhead — the difference is pure
+    decode steps)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+
+    batch, prompt_len = 8, 128
+    cfg = dataclasses.replace(GPT2Config.small(), dtype="bfloat16", max_seq=1024)
+    model = GPT2(cfg)
+    dev = jax.devices()[0]
+    params = jax.device_put(model.init(0), dev)
+    rng = np.random.default_rng(0)
+    prompt = jax.device_put(
+        jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, prompt_len)), jnp.int32), dev
+    )
+
+    n_short, n_long = 16, 144
+
+    def timed(n_new, reps=5):
+        np.asarray(model.generate(params, prompt, n_new))  # compile + sync
+        ts = []
+        for _ in range(reps):
+            t0 = time.monotonic()
+            np.asarray(model.generate(params, prompt, n_new))  # D2H forces sync
+            ts.append(time.monotonic() - t0)
+        return float(np.percentile(ts, 50))
+
+    per_step = (timed(n_long) - timed(n_short)) / (n_long - n_short)
+    return {
+        "gpt2_decode_tokens_per_sec": round(batch / per_step, 1),
+        "gpt2_decode_step_ms": round(per_step * 1e3, 3),
+        "gpt2_decode_batch": batch,
+        "gpt2_decode_prompt_len": prompt_len,
+    }
 
 
 def _gpt2_train_throughput(

@@ -345,7 +345,12 @@ class GPT2:
             h = h + self._mlp_block(layer["mlp"], _layer_norm(h, **layer["ln_2"]), tp_axis)
         return h
 
+    _ATTN_IMPLS = ("ring", "ulysses", "ulysses_flash", "ring_flash", "flash", "xla")
+
     def _attn_block(self, layer, h, n_head_local, tp_axis, sp_axis, attn_impl):
+        if attn_impl not in self._ATTN_IMPLS:
+            # a typo would otherwise silently train on the ring/XLA fallback
+            raise ValueError(f"unknown attn_impl {attn_impl!r}; choose from {self._ATTN_IMPLS}")
         x = _layer_norm(h, **layer["ln_1"])
         q, k, v = self._qkv_heads(layer, x, n_head_local)
         if sp_axis:

@@ -37,6 +37,7 @@ class GenerateConfig(Config):
     top_k: int = field(32, help="0 = full distribution")
     top_p: float = field(0.0, help="nucleus sampling mass (0 = off)")
     seed: int = field(0, help="sampling seed")
+    tp: int = field(1, help="tensor-parallel serving: shard heads/vocab/KV-cache over this many devices (generate_spmd)")
 
 
 def main(argv=None):
@@ -68,15 +69,27 @@ def main(argv=None):
     prompt_bytes = prompt_bytes % model_cfg.vocab_size
     prompt = jnp.asarray(np.tile(prompt_bytes, (cfg.n_samples, 1)))
 
-    out = model.generate(
-        params,
-        prompt,
+    sample_kwargs = dict(
         max_new_tokens=cfg.max_new_tokens,
         temperature=cfg.temperature,
         top_k=cfg.top_k,
         top_p=cfg.top_p,
         seed=cfg.seed,
     )
+    if cfg.tp > 1:
+        # TP-sharded serving: Megatron-sharded params, per-rank KV-cache
+        # shard, token-identical to the single-device path
+        import jax
+
+        from dsml_tpu.parallel.hybrid import shard_params
+        from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        mesh = build_mesh(MeshSpec(tp=cfg.tp), jax.devices()[: cfg.tp])
+        placed = shard_params(params, mesh, model.param_specs())
+        log.info("serving TP-sharded over %d devices", cfg.tp)
+        out = model.generate_spmd(placed, prompt, mesh=mesh, **sample_kwargs)
+    else:
+        out = model.generate(params, prompt, **sample_kwargs)
     texts = []
     for row in np.asarray(out):
         text = bytes(int(t) % 256 for t in row).decode("utf-8", errors="replace")

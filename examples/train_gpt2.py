@@ -50,7 +50,7 @@ class GPT2TrainConfig(Config):
     dp: int = field(0, help="data-parallel size (0 = derive from devices)")
     sp: int = field(1, help="sequence-parallel size")
     tp: int = field(1, help="tensor-parallel size")
-    attn: str = field("ring", help="sequence-parallel attention: ring | ulysses")
+    attn: str = field("ring", help="attention impl: ring | ulysses | ulysses_flash | ring_flash | flash | xla (flash variants = Pallas kernels)")
     lr: float = field(3e-4, help="peak learning rate")
     warmup_steps: int = field(10, help="linear warmup steps")
     seed: int = field(0, help="init/data seed")
