@@ -177,6 +177,6 @@ def test_gpt2_flash_attn_impl_matches_default():
     params = model.init(0)
     rng = np.random.default_rng(4)
     tokens = jnp.asarray(rng.integers(0, 512, size=(2, 128)), jnp.int32)
-    base = model.apply_spmd(params, tokens, attn_impl="none")
+    base = model.apply_spmd(params, tokens, attn_impl="xla")
     flash = model.apply_spmd(params, tokens, attn_impl="flash")
     np.testing.assert_allclose(np.asarray(flash), np.asarray(base), rtol=1e-4, atol=1e-4)
