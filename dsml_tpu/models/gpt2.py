@@ -67,6 +67,32 @@ class GPT2Config:
         return GPT2Config()
 
     @staticmethod
+    def medium() -> "GPT2Config":
+        """GPT-2-medium, 350M params."""
+        return GPT2Config(n_layer=24, n_head=16, d_model=1024, d_ff=4096)
+
+    @staticmethod
+    def large() -> "GPT2Config":
+        """GPT-2-large, 774M params."""
+        return GPT2Config(n_layer=36, n_head=20, d_model=1280, d_ff=5120)
+
+    @staticmethod
+    def xl() -> "GPT2Config":
+        """GPT-2-XL, 1.5B params."""
+        return GPT2Config(n_layer=48, n_head=25, d_model=1600, d_ff=6400)
+
+    @classmethod
+    def by_name(cls, name: str, **tiny_kwargs) -> "GPT2Config":
+        """Preset lookup over the EXPLICIT family ({tiny, small, medium,
+        large, xl}) — a raw getattr would accept any class attribute and
+        fail obscurely."""
+        presets = {"tiny": cls.tiny, "small": cls.small, "medium": cls.medium,
+                   "large": cls.large, "xl": cls.xl}
+        if name not in presets:
+            raise ValueError(f"unknown GPT-2 preset {name!r}; choose from {sorted(presets)}")
+        return presets[name](**tiny_kwargs) if name == "tiny" else presets[name]()
+
+    @staticmethod
     def tiny(vocab_size: int = 512, n_experts: int = 0) -> "GPT2Config":
         """Test-sized config that still exercises every code path."""
         return GPT2Config(

@@ -50,8 +50,11 @@ def make_schedule(
     if name in ("constant", "plateau"):  # plateau = constant base + reactive scale
         body = optax.constant_schedule(base_lr)
     elif name == "cosine":
+        # optax needs warmup ≥ 1 AND decay span > warmup; a 1-step run would
+        # otherwise produce decay_steps = 0
+        warmup = max(warmup_steps, 1)
         return optax.warmup_cosine_decay_schedule(
-            0.0, base_lr, max(warmup_steps, 1), total_steps, end_value=base_lr * end_lr_frac
+            0.0, base_lr, warmup, max(total_steps, warmup + 1), end_value=base_lr * end_lr_frac
         )
     elif name == "linear":
         body = optax.linear_schedule(base_lr, base_lr * end_lr_frac, total_steps - warmup_steps)

@@ -28,7 +28,7 @@ from dsml_tpu.utils.config import Config, field
 class GenerateConfig(Config):
     platform: str = field("", help="jax platform override: cpu|tpu ('' = default)")
     cpu_devices: int = field(0, help="virtual CPU device count for --platform cpu")
-    model: str = field("tiny", help="tiny | small — must match the trained model")
+    model: str = field("tiny", help="tiny | small | medium | large | xl — must match the trained model")
     checkpoint_dir: str = field("", help="Orbax dir from train_gpt2 ('' = fresh weights)")
     prompt: str = field("the cat ", help="prompt text (byte-tokenized)")
     n_samples: int = field(2, help="continuations to sample")
@@ -52,7 +52,10 @@ def main(argv=None):
     from dsml_tpu.utils.logging import get_logger
 
     log = get_logger("generate")
-    model_cfg = GPT2Config.small() if cfg.model == "small" else GPT2Config.tiny(vocab_size=256)
+    try:
+        model_cfg = GPT2Config.by_name(cfg.model, vocab_size=256)  # tiny = byte tokens
+    except ValueError as e:
+        raise SystemExit(str(e))
     model = GPT2(model_cfg)
     params = model.init(0)
     if cfg.checkpoint_dir:

@@ -36,7 +36,7 @@ from dsml_tpu.utils.config import Config, field
 class GPT2TrainConfig(Config):
     platform: str = field("", help="jax platform override: cpu|tpu ('' = default)")
     cpu_devices: int = field(0, help="virtual CPU device count for --platform cpu")
-    model: str = field("tiny", help="tiny | small (125M, the BASELINE config)")
+    model: str = field("tiny", help="tiny | small (125M, the BASELINE config) | medium | large | xl")
     dtype: str = field("", help="params/activations dtype: float32 | bfloat16 ('' = model default; bfloat16 feeds the MXU at full rate on TPU)")
     remat: bool = field(False, help="rematerialize each block's activations in backward (less HBM, more FLOPs)")
     data: str = field("", help="UTF-8 text file to train on ('' = generated stories)")
@@ -123,9 +123,10 @@ def main(argv=None):
                 f"rows per dp rank; using n_micro={n_micro}"
             )
 
-    model_cfg = GPT2Config.small() if cfg.model == "small" else GPT2Config.tiny(vocab_size=256)
-    if cfg.model == "tiny":
-        model_cfg = dataclasses.replace(model_cfg, vocab_size=256)  # byte tokens
+    try:
+        model_cfg = GPT2Config.by_name(cfg.model, vocab_size=256)  # tiny = byte tokens
+    except ValueError as e:
+        raise SystemExit(str(e))
     if cfg.dtype:
         model_cfg = dataclasses.replace(model_cfg, dtype=cfg.dtype)
     if cfg.remat:
