@@ -54,8 +54,11 @@ class GPT2Config:
     capacity_factor: float = 1.25
     # rematerialization: recompute each block's activations in the backward
     # pass instead of storing them — trades FLOPs for HBM (the memory-
-    # efficiency capability of the reference's §7 literature, ActNN/GACT)
-    remat: bool = False
+    # efficiency capability of the reference's §7 literature, ActNN/GACT).
+    # True = plain jax.checkpoint (full-precision input stash); "int8" =
+    # compressed remat (ops.quantization.compressed_checkpoint): the stash is
+    # blockwise-int8, 4x smaller again, gradients exact in expectation
+    remat: bool | str = False
     # unsharded-vocab losses stream the unembedding in chunks of this many
     # rows (ops/xent.py) instead of materializing [tokens, vocab] logits;
     # only kicks in when vocab_size > xent_chunk (0 disables)
@@ -328,6 +331,10 @@ class GPT2:
         """Embedding + transformer block stack → PRE-final-norm hidden
         states [b, s, d]."""
         cfg = self.config
+        if cfg.remat not in (False, True, "int8"):
+            # a typo ("INT8", "int4") would otherwise silently degrade to
+            # plain remat here and to NO remat in the pipeline path
+            raise ValueError(f"unknown remat mode {cfg.remat!r}; choose False, True, or 'int8'")
         block = self._block_closure(tp_axis, sp_axis, attn_impl)
         h = self._embed_spmd(params, tokens, tp_axis, sp_axis, seq_offset)
 
@@ -344,7 +351,11 @@ class GPT2:
             outs = pipeline_apply(block, params["layers"], micro, pp_axis, remat=cfg.remat)
             h = outs.reshape(b, *h.shape[1:])
         else:
-            if cfg.remat:
+            if cfg.remat == "int8":
+                from dsml_tpu.ops.quantization import compressed_checkpoint
+
+                block = compressed_checkpoint(block)
+            elif cfg.remat:
                 block = jax.checkpoint(block)
             for layer in params["layers"]:
                 h = block(layer, h)

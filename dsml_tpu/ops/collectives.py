@@ -175,6 +175,29 @@ def naive_all_reduce(x: jax.Array, axis_name: str, op: ReduceOp = ReduceOp.SUM) 
     return out.astype(x.dtype)
 
 
+def auto_all_reduce_algorithm(nbytes: int, n_devices: int, latency_bytes: int = 32768) -> str:
+    """Payload-aware algorithm selection (the Blink/TACOS §6 Communication
+    literature point — SURVEY.md §2.4: pick the collective schedule by where
+    it sits on the latency/bandwidth tradeoff, not one-size-fits-all).
+
+    Alpha-beta model with per-round latency α and per-byte time β: naive
+    gather+reduce costs α + (n−1)·S·β (ONE round, every rank receives the
+    other n−1 shards); the explicit ring costs 2(n−1)·α + ~2S·β (2(n−1)
+    serialized rounds, bandwidth-optimal volume). Naive wins iff
+    (n−3)·S·β < (2n−3)·α, i.e. S below a crossover that DEPENDS on n:
+    ``latency_bytes`` is α/β — the payload whose transfer time equals one
+    round of link latency — and the crossover is
+    ``latency_bytes · (2n−3)/(n−3)`` (≈ 2·latency_bytes for large n; at
+    n ≤ 3 the ring's extra rounds can never pay for its ≤ 0 byte savings,
+    so naive always wins). Both inputs are static at trace time, so the
+    choice costs nothing at runtime.
+    """
+    if n_devices <= 3:
+        return "naive"
+    crossover = latency_bytes * (2 * n_devices - 3) / (n_devices - 3)
+    return "naive" if nbytes <= crossover else "ring"
+
+
 def all_reduce(
     x: jax.Array,
     axis_name: str,
@@ -189,8 +212,17 @@ def all_reduce(
     ``ring``  — the explicit 2(n-1)-step ring (honest ring-latency numbers,
                 BASELINE.md metric).
     ``naive`` — gather+reduce baseline.
+    ``auto``  — pick ring vs naive from the static payload size and axis
+                size (:func:`auto_all_reduce_algorithm`): latency-optimal
+                one-round gather for small payloads, bandwidth-optimal ring
+                for large — for deployments that want the explicit schedules
+                (e.g. the wire-API coordinator) with topology awareness.
     """
     op = ReduceOp(op)
+    if algorithm == "auto":
+        algorithm = auto_all_reduce_algorithm(
+            x.size * x.dtype.itemsize, _axis_size(axis_name)
+        )
     if algorithm == "ring":
         return ring_all_reduce(x, axis_name, op)
     if algorithm == "naive":

@@ -44,7 +44,7 @@ def pipeline_apply(
     stage_params,
     microbatches: jax.Array,
     axis: str = "pp",
-    remat: bool = False,
+    remat: bool | str = False,
 ) -> jax.Array:
     """Run a layer stack as a pipeline. Call under ``shard_map``.
 
@@ -57,13 +57,24 @@ def pipeline_apply(
     backward pass: activation memory stops scaling with the number of
     microbatches in flight — the memory property 1F1B scheduling
     (PipeDream, SURVEY.md §2.3) buys, achieved compiler-side instead of by
-    hand-interleaving forward/backward.
+    hand-interleaving forward/backward. ``remat="int8"`` additionally
+    compresses each LAYER's stashed input to blockwise int8
+    (``ops.quantization.compressed_checkpoint``, the ActNN/GACT capability)
+    — per-layer granularity, since the compressed stash is what bounds
+    memory rather than the checkpoint cut.
 
     Returns [M, microbatch, ...] outputs, replicated to every rank.
     """
+    if remat not in (False, True, "int8"):
+        raise ValueError(f"unknown remat mode {remat!r}; choose False, True, or 'int8'")
     n_stage = lax.axis_size(axis)
     rank = lax.axis_index(axis)
     n_micro = microbatches.shape[0]
+
+    if remat == "int8":
+        from dsml_tpu.ops.quantization import compressed_checkpoint
+
+        layer_fn = compressed_checkpoint(layer_fn)
 
     def stage_fn(x):
         def body(h, one_layer):
@@ -72,7 +83,7 @@ def pipeline_apply(
         out, _ = lax.scan(body, x, stage_params)
         return out
 
-    if remat:
+    if remat is True:
         stage_fn = jax.checkpoint(stage_fn)
 
     if n_stage == 1:

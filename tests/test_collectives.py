@@ -46,7 +46,7 @@ def _np_reduce(xs, op):
 
 
 @pytest.mark.parametrize("op", list(C.ReduceOp))
-@pytest.mark.parametrize("algorithm", ["ring", "naive", "xla"])
+@pytest.mark.parametrize("algorithm", ["ring", "naive", "xla", "auto"])
 def test_all_reduce_all_ops(mesh8, op, algorithm):
     xs = _stack(8, (33,), np.float32)  # 33 not divisible by 8 → exercises padding
     fn = lambda x: C.all_reduce(x[0], "dev", op, algorithm)[None]
@@ -140,3 +140,29 @@ def test_make_stacked_all_reduce_host_api(mesh8):
     expected = xs.sum(axis=0)
     for r in range(8):
         np.testing.assert_allclose(out[r], expected, rtol=1e-4)
+
+
+def test_auto_algorithm_selection_rule():
+    """Payload-aware selection (Blink/TACOS §6): one-round gather when link
+    latency dominates, bandwidth-optimal ring when volume does — with a
+    crossover that tightens as n grows (naive's volume scales with n−1)."""
+    pick = C.auto_all_reduce_algorithm
+    assert pick(1024, 8) == "naive"  # tiny payload → latency-optimal
+    # n=8 crossover = 32768·13/5 ≈ 85 KiB
+    assert pick(64 * 1024, 8) == "naive"
+    assert pick(90 * 1024, 8) == "ring"
+    assert pick(1 << 20, 8) == "ring"
+    assert pick(1 << 30, 2) == "naive"  # n≤3: ring can't win
+    assert pick(1 << 30, 3) == "naive"
+    # large n: crossover ≈ 2·latency_bytes, NOT unbounded
+    assert pick(32 * 1024, 64) == "naive"
+    assert pick(128 * 1024, 64) == "ring"
+
+
+def test_auto_matches_exact_both_regimes(mesh8):
+    """auto must be numerically exact whichever schedule it picks."""
+    for n_elem in (64, 262_144):  # 256 B (naive regime) and 1 MB (ring regime)
+        xs = _stack(8, (n_elem,), np.float32, seed=11)
+        fn = lambda x: C.all_reduce(x[0], "dev", C.ReduceOp.SUM, "auto")[None]
+        out = _run_collective(mesh8, fn, xs)
+        np.testing.assert_allclose(out[0], xs.sum(axis=0), rtol=1e-4)

@@ -351,6 +351,53 @@ def test_remat_gradients_identical(hybrid_mesh):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5)
 
 
+def test_int8_remat_gradients_close(hybrid_mesh):
+    """Compressed remat (remat="int8", the ActNN/GACT capability): the stash
+    is quantized, so grads are approximate — but bounded by the quantization
+    noise and close enough to train. Forward loss is untouched."""
+    import dataclasses
+
+    from dsml_tpu.parallel.hybrid import hybrid_loss_fn, shard_params
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    cfg = GPT2Config.tiny()
+    x, y = _batch(cfg, seed=33)
+    base = GPT2(cfg)
+    q8 = GPT2(dataclasses.replace(cfg, remat="int8"))
+    params = base.init(32)
+
+    # forward identical: compression touches only the backward stash
+    np.testing.assert_allclose(
+        float(jax.jit(q8.loss)(params, x, y)),
+        float(jax.jit(base.loss)(params, x, y)),
+        rtol=1e-6,
+    )
+
+    g0 = jax.jit(jax.grad(base.loss))(params, x, y)
+    g1 = jax.jit(jax.grad(q8.loss))(params, x, y)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        a, b = np.asarray(a), np.asarray(b)
+        denom = np.abs(a).max() + 1e-6
+        assert np.abs(a - b).max() / denom < 0.1, np.abs(a - b).max() / denom
+
+    # and through the sharded hybrid loss (tp psums + ring attention inside
+    # the custom_vjp's recompute)
+    sharded = jax.shard_map(
+        lambda p, xx, yy: lax.pmean(hybrid_loss_fn(q8)(p, xx, yy), ("dp", "sp")),
+        mesh=hybrid_mesh,
+        in_specs=(q8.param_specs(), P("dp", "sp"), P("dp", "sp")),
+        out_specs=P(),
+        check_vma=False,
+    )
+    placed = shard_params(params, hybrid_mesh, q8.param_specs())
+    gs = jax.jit(jax.grad(sharded))(placed, x, y)
+    for a, b in zip(jax.tree.leaves(gs), jax.tree.leaves(g0)):
+        a, b = np.asarray(a), np.asarray(b)
+        denom = np.abs(b).max() + 1e-6
+        assert np.abs(a - b).max() / denom < 0.1, np.abs(a - b).max() / denom
+
+
 def test_bfloat16_hybrid_training_converges(hybrid_mesh):
     """bf16 params/activations (the TPU MXU-native dtype) through the full
     hybrid step: loss finite and decreasing; f32 loss accumulation inside."""

@@ -9,7 +9,13 @@ import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dsml_tpu.ops.collectives import ReduceOp, all_reduce, hierarchical_all_reduce
-from dsml_tpu.ops.quantization import compressed_all_reduce, dequantize_int8, quantize_int8
+from dsml_tpu.ops.quantization import (
+    QuantizedTensor,
+    compressed_all_reduce,
+    compressed_checkpoint,
+    dequantize_int8,
+    quantize_int8,
+)
 
 
 def test_quantize_roundtrip_error_bounded():
@@ -71,6 +77,108 @@ def test_q8_training_converges(dp_mesh8):
     _, history, test_acc = trainer.train(data)
     assert history[-1]["avg_loss"] < history[0]["avg_loss"]
     assert test_acc > 0.8
+
+
+def _two_layer(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"]
+
+
+def _tiny_params(rng):
+    return {
+        "w1": jnp.asarray(rng.standard_normal((32, 64)) * 0.1, jnp.float32),
+        "b1": jnp.zeros((64,), jnp.float32),
+        "w2": jnp.asarray(rng.standard_normal((64, 32)) * 0.1, jnp.float32),
+    }
+
+
+def test_compressed_checkpoint_forward_exact():
+    """The forward pass is untouched — compression affects only the stash."""
+    rng = np.random.default_rng(5)
+    params = _tiny_params(rng)
+    x = jnp.asarray(rng.standard_normal((8, 32)), jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(compressed_checkpoint(_two_layer)(params, x)),
+        np.asarray(_two_layer(params, x)),
+    )
+
+
+def test_compressed_checkpoint_grads_close_and_int8_stash():
+    rng = np.random.default_rng(6)
+    params = _tiny_params(rng)
+    x = jnp.asarray(rng.standard_normal((8, 32)), jnp.float32)
+
+    def loss(f):
+        return lambda p, xx: jnp.sum(f(p, xx) ** 2)
+
+    g_exact = jax.grad(loss(_two_layer), argnums=(0, 1))(params, x)
+    wrapped = compressed_checkpoint(_two_layer, seed=3)
+    g_comp = jax.jit(jax.grad(loss(wrapped), argnums=(0, 1)))(params, x)
+    # gradient error is bounded by the input quantization noise, which is
+    # ~|x|_blockmax/127 per element — small relative to the grads themselves
+    for e, c in zip(jax.tree.leaves(g_exact), jax.tree.leaves(g_comp)):
+        denom = np.abs(np.asarray(e)).max() + 1e-6
+        assert np.abs(np.asarray(e - c)).max() / denom < 0.05
+
+    # the residual that crosses the vjp boundary really is the int8 stash
+    _, vjp_fn = jax.vjp(lambda p, xx: wrapped(p, xx), params, x)
+    stash_dtypes = {
+        str(l.dtype) for l in jax.tree.leaves(vjp_fn) if hasattr(l, "dtype")
+    }
+    assert "int8" in stash_dtypes, stash_dtypes
+
+
+def test_compressed_checkpoint_int_leaves_pass_through():
+    """Integer activations (token ids) must be stashed exactly, not quantized."""
+    emb = jnp.asarray(np.random.default_rng(7).standard_normal((16, 8)), jnp.float32)
+
+    def fn(params, x):
+        return params[x["ids"]] * x["scale"]
+
+    ids = jnp.arange(4, dtype=jnp.int32)
+    x = {"ids": ids, "scale": jnp.ones((4, 1), jnp.float32)}
+    g = jax.grad(lambda p: jnp.sum(compressed_checkpoint(fn)(p, x)))(emb)
+    g_ref = jax.grad(lambda p: jnp.sum(fn(p, x)))(emb)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-6)
+
+
+def test_compressed_checkpoint_under_shard_map_with_collective(mesh8):
+    """fn containing a psum (the TP pattern): the backward's vjp must
+    transpose the collective correctly from inside the custom_vjp."""
+    rng = np.random.default_rng(8)
+    w = jnp.asarray(rng.standard_normal((8, 16, 4)) * 0.3, jnp.float32)
+    x = jnp.asarray(rng.standard_normal((8, 2, 16)), jnp.float32)
+
+    def fn(params, xx):  # row-parallel matmul: psum of partial products
+        return jax.lax.psum(xx @ params, "dev")
+
+    def per_rank(make):
+        def run(w_shard, x_shard):
+            y = make(fn)(w_shard[0], x_shard[0])
+            return jnp.sum(y * y)[None]
+
+        return jax.shard_map(
+            run, mesh=mesh8, in_specs=(P("dev"), P("dev")), out_specs=P("dev"),
+            check_vma=False,
+        )
+
+    def total(make):
+        return lambda ww: jnp.sum(per_rank(make)(ww, x)) / 8
+
+    g_ref = jax.grad(total(lambda f: f))(w)
+    g_comp = jax.jit(jax.grad(total(compressed_checkpoint)))(w)
+    denom = np.abs(np.asarray(g_ref)).max()
+    assert np.abs(np.asarray(g_ref - g_comp)).max() / denom < 0.05
+
+
+def test_quantized_tensor_static_metadata():
+    """size/shape/dtype are aux_data, not traced leaves — the property that
+    lets QuantizedTensor cross jit boundaries as a residual."""
+    qt = quantize_int8(jnp.ones((10,), jnp.float32))
+    leaves, treedef = jax.tree.flatten(qt)
+    assert len(leaves) == 2  # values, scales only
+    rebuilt = jax.tree.unflatten(treedef, leaves)
+    assert isinstance(rebuilt, QuantizedTensor) and rebuilt.size == 10
 
 
 @pytest.mark.parametrize("grid", [(2, 4), (4, 2)])
