@@ -1,0 +1,235 @@
+"""Elastic training: survive device loss by re-planning the mesh mid-run.
+
+The reference detects failures and marks the communicator dead — permanently
+(``gpu_coordinator_server.go:114-118``; recovery "none", SURVEY.md §5.3). Its
+fault-tolerance literature (Varuna `3492321.3519584.pdf`, Bamboo
+`nsdi23-thorpe.pdf`, Oobleck `2309.08125v2.pdf` — §2.4 folder 5) is about the
+missing half: CONTINUING the job on the survivors. This module is that half,
+TPU-style:
+
+- The comm layer already does detection + communicator renumbering
+  (``comm.coordinator``, ``CoordinatorConfig(elastic=True)``). Here the
+  TRAINING STATE moves: :func:`reconfigure` takes a live (params, opt_state)
+  sharded over a failed mesh, re-plans the parallelism for the survivor
+  fleet (Oobleck's "pipeline template" re-instantiation, realized as
+  ``parallel.auto.plan_mesh`` over the new device count), and re-shards the
+  state onto the new mesh — no restart, no checkpoint round-trip.
+- Recoverability of the state itself follows from the sharding layout, and
+  :func:`check_recoverable` makes that auditable before a failure happens
+  (Bamboo's redundant-computation guarantee, by construction instead of by
+  extra compute): any leaf that is REPLICATED over some mesh axis survives
+  the loss of all-but-one rank of that axis; a leaf sharded over a lost
+  device is gone and needs the checkpoint fallback (``utils.checkpoint``,
+  Varuna's approach — the caller chooses per
+  :class:`ElasticPolicy`).
+
+On a single TPU host device loss takes the process with it, so the unit of
+failure this module models is the MESH SHRINKING between steps — exactly
+what multi-host JAX gives you when a host drops and ``jax.devices()``
+re-forms smaller. Tests simulate it by rebuilding meshes over device
+subsets of the virtual CPU fleet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dsml_tpu.parallel.auto import plan_mesh
+from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+
+__all__ = ["ElasticPolicy", "check_recoverable", "reconfigure", "ElasticState"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticPolicy:
+    """What to do when devices are lost.
+
+    ``allow_shrink`` — re-plan onto the survivors (False = fail fast, the
+    reference's behavior). ``require_full_state`` — refuse to continue if
+    any state leaf was exclusively sharded on lost devices (True means: fall
+    back to your checkpoint instead of silently training on a torn state).
+    """
+
+    allow_shrink: bool = True
+    require_full_state: bool = True
+
+
+@dataclasses.dataclass
+class ElasticState:
+    """Result of a reconfiguration."""
+
+    params: object
+    opt_state: object
+    mesh: Mesh
+    spec: MeshSpec
+    reasons: tuple[str, ...]  # the auto-planner's audit trail for the new mesh
+
+
+def _leaf_shardings(tree):
+    return [
+        (leaf, getattr(leaf, "sharding", None))
+        for leaf in jax.tree.leaves(tree)
+        if isinstance(leaf, jax.Array)
+    ]
+
+
+def check_recoverable(state, lost_devices) -> list[str]:
+    """Which state leaves would be LOST if ``lost_devices`` die right now?
+
+    A leaf survives iff every shard of its value lives on at least one
+    surviving device — i.e. for each addressable shard index, some replica
+    sits outside ``lost_devices``. Returns a list of human-readable
+    descriptions of unrecoverable leaves (empty = fully recoverable, the
+    state every DP/replicated layout gives you)."""
+    lost = {d.id for d in lost_devices}
+    torn: list[str] = []
+    for leaf, sharding in _leaf_shardings(state):
+        if sharding is None:  # host array: nothing to lose
+            continue
+        # group shards by the data they hold (device_indices_map: device →
+        # index tuple); a piece is safe iff some holder survives
+        holders: dict = {}
+        for dev, idx in sharding.devices_indices_map(leaf.shape).items():
+            key = tuple((s.start, s.stop) for s in idx if isinstance(s, slice))
+            holders.setdefault(key, []).append(dev.id)
+        for piece, devs in holders.items():
+            if all(d in lost for d in devs):
+                torn.append(f"shape={leaf.shape} piece={piece} only on lost devices {devs}")
+                break
+    return torn
+
+
+def reconfigure(
+    model,
+    optimizer,
+    params,
+    opt_state,
+    surviving_devices,
+    lost_devices=(),
+    policy: ElasticPolicy = ElasticPolicy(),
+    batch_per_device: int = 1,
+    global_batch: int | None = None,
+) -> ElasticState:
+    """Continue training on the survivor fleet.
+
+    1. Audit recoverability (:func:`check_recoverable`) — under
+       ``require_full_state`` a torn state raises instead of continuing
+       (checkpoint fallback is the caller's move, ``utils.checkpoint``).
+    2. Re-plan parallelism for ``len(surviving_devices)`` chips with the
+       capacity-rule planner (the Oobleck template re-instantiation). With
+       ``global_batch`` set, the plan must also keep the batch divisible by
+       its dp width — survivor counts that can't (e.g. 5 chips for a batch
+       of 4) instantiate the template on the largest workable device SUBSET
+       and idle the rest, Oobleck's choice: n−1 busy chips beat a crash.
+    3. Pull state to host once and re-shard onto the new mesh.
+
+    Returns :class:`ElasticState` with the new (params, opt_state, mesh);
+    the caller rebuilds its step function with
+    ``make_hybrid_train_step(model, optimizer, new_mesh)`` (jit caches keyed
+    on the mesh make this a fresh compile, as it must be)."""
+    if not policy.allow_shrink:
+        raise RuntimeError(
+            f"{len(lost_devices)} device(s) lost and ElasticPolicy.allow_shrink=False "
+            "(reference semantics: communicator FAILED, job dead)"
+        )
+    if policy.require_full_state and lost_devices:
+        torn = check_recoverable((params, opt_state), lost_devices)
+        if torn:
+            raise RuntimeError(
+                "training state not recoverable from survivors — restore from "
+                f"checkpoint instead; torn leaves: {torn[:3]}"
+            )
+
+    cfg = getattr(model, "config", None)
+    old_pp = isinstance(params.get("layers"), dict) if isinstance(params, dict) else False
+    survivors = list(surviving_devices)
+    plan = None
+    for n_use in range(len(survivors), 0, -1):
+        candidate = plan_mesh(
+            n_devices=n_use,
+            n_params=model.n_params(params),
+            n_head=getattr(cfg, "n_head", None),
+            seq_len=getattr(cfg, "max_seq", 0),
+            d_model=getattr(cfg, "d_model", 0),
+            n_layer=getattr(cfg, "n_layer", 0),
+            batch_per_device=batch_per_device,
+        )
+        if global_batch is None or global_batch % candidate.spec.dp == 0:
+            plan = candidate
+            if n_use < len(survivors):
+                plan = dataclasses.replace(
+                    plan,
+                    reasons=plan.reasons
+                    + (
+                        f"global batch {global_batch} not divisible by the "
+                        f"{len(survivors)}-chip plan's dp → instantiated on "
+                        f"{n_use} chips, {len(survivors) - n_use} idle",
+                    ),
+                )
+            survivors = survivors[:n_use]
+            break
+    assert plan is not None  # n_use=1 always divides
+    new_mesh = build_mesh(plan.spec, survivors)
+
+    # host round-trip: survivors hold every piece (audited above), so
+    # device_get reassembles full values; device_put lays them out fresh
+    pspecs = model.param_specs(pp=plan.spec.pp > 1)
+    host_params = jax.device_get(params)
+    host_opt = jax.device_get(opt_state)
+    if old_pp and plan.spec.pp == 1:
+        # the failed mesh ran a pipeline (stacked layer axis); the new plan
+        # doesn't — unstack params back to the per-layer list form, and
+        # apply the SAME transform to every params-shaped subtree of the
+        # optimizer state (adam's mu/nu mirror the param tree)
+        n_layer = jax.tree.leaves(host_params["layers"])[0].shape[0]
+
+        def unstack(node):
+            if isinstance(node, dict):
+                if "layers" in node and isinstance(node["layers"], dict):
+                    layers = [
+                        jax.tree.map(lambda l: l[i], node["layers"]) for i in range(n_layer)
+                    ]
+                    return {
+                        **{k: unstack(v) for k, v in node.items() if k != "layers"},
+                        "layers": layers,
+                    }
+                return {k: unstack(v) for k, v in node.items()}
+            if isinstance(node, tuple):
+                mapped = [unstack(v) for v in node]
+                return type(node)(*mapped) if hasattr(node, "_fields") else tuple(mapped)
+            if isinstance(node, list):
+                return [unstack(v) for v in node]
+            return node
+
+        host_params = unstack(host_params)
+        host_opt = unstack(host_opt)
+    from dsml_tpu.parallel.hybrid import shard_params
+
+    new_params = shard_params(host_params, new_mesh, pspecs)
+    # optimizer statistics adopt the param shardings directly (adam's mu/nu
+    # mirror the param tree; scalars like the step count replicate) — no
+    # fresh optimizer.init, whose transient zeros would double-allocate HBM
+    # at exactly the moment the shrunken fleet has the least headroom
+    import optax.tree_utils as otu
+
+    param_shardings = jax.tree.map(
+        lambda s: NamedSharding(new_mesh, s), pspecs, is_leaf=lambda x: isinstance(x, P)
+    )
+    replicated = NamedSharding(new_mesh, P())
+    new_opt = otu.tree_map_params(
+        optimizer,
+        lambda old, sh: jax.device_put(np.asarray(old), sh),
+        host_opt,
+        param_shardings,
+        transform_non_params=lambda leaf: (
+            jax.device_put(np.asarray(leaf), replicated) if leaf is not None else leaf
+        ),
+    )
+    return ElasticState(
+        params=new_params, opt_state=new_opt, mesh=new_mesh, spec=plan.spec,
+        reasons=plan.reasons,
+    )

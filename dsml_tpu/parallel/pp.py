@@ -67,6 +67,9 @@ def pipeline_apply(
     """
     if remat not in (False, True, "int8"):
         raise ValueError(f"unknown remat mode {remat!r}; choose False, True, or 'int8'")
+    if not isinstance(remat, str):
+        remat = bool(remat)  # 1 passes validation (1 == True); normalize so
+        # the `remat is True` dispatch below can't silently drop remat
     n_stage = lax.axis_size(axis)
     rank = lax.axis_index(axis)
     n_micro = microbatches.shape[0]

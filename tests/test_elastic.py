@@ -1,0 +1,176 @@
+"""Elastic training: continue on survivors after device loss.
+
+The reference's recovery story ends at "communicator FAILED, job dead"
+(gpu_coordinator_server.go:114-118; SURVEY.md §5.3 "Recovery/elasticity:
+none"); its §5 Fault Tolerance literature (Varuna/Bamboo/Oobleck) is the
+roadmap for the other half. These tests pin the training-state half:
+re-plan + re-shard + continue, with recoverability audited first.
+
+Device "loss" is simulated by rebuilding meshes over subsets of the virtual
+8-CPU fleet — the mesh-shrinks-between-steps model that multi-host JAX
+presents when a host drops.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+from dsml_tpu.parallel.elastic import ElasticPolicy, check_recoverable, reconfigure
+from dsml_tpu.parallel.hybrid import init_hybrid, make_hybrid_train_step
+from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+
+
+def _data(cfg, n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, cfg.vocab_size, (n, cfg.max_seq)).astype(np.int32)
+    return x, np.roll(x, -1, 1).astype(np.int32)
+
+
+def test_elastic_shrink_8_to_4_training_continuous(devices8):
+    """Lose half the fleet mid-run: training continues on the survivors and
+    the loss trajectory matches an uninterrupted run (same global batch, DP
+    math is mesh-shape-invariant)."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    opt = optax.adam(1e-2)
+    x, y = _data(cfg)
+
+    # uninterrupted 6-step run on the full mesh = the reference trajectory
+    mesh8 = build_mesh(MeshSpec(dp=4, sp=1, tp=2), devices8)
+    step = make_hybrid_train_step(model, opt, mesh8, attn_impl="ring")
+    params, opt_state = init_hybrid(model, opt, mesh8, seed=0)
+    ref_losses = []
+    for _ in range(6):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        ref_losses.append(float(loss))
+
+    # interrupted run: 3 steps, lose 4 devices, reconfigure, 3 more steps
+    params, opt_state = init_hybrid(model, opt, mesh8, seed=0)
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        losses.append(float(loss))
+    state = reconfigure(
+        model, opt, params, opt_state,
+        surviving_devices=devices8[:4], lost_devices=devices8[4:],
+    )
+    assert int(np.prod([state.spec.pp, state.spec.dp, state.spec.fsdp,
+                        state.spec.sp, state.spec.tp])) == 4
+    assert state.reasons  # audit trail present
+    step2 = make_hybrid_train_step(model, opt, state.mesh, attn_impl="ring")
+    params2, opt_state2 = state.params, state.opt_state
+    for _ in range(3):
+        params2, opt_state2, loss = step2(params2, opt_state2, x, y)
+        losses.append(float(loss))
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=5e-3)
+
+
+def test_elastic_from_pipeline_mesh_unstacks(devices8):
+    """A pp=2 run (stacked layer axis) shrinking onto a pipeline-less plan:
+    params AND adam statistics unstack to the per-layer form, values intact."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    opt = optax.adam(1e-2)
+    x, y = _data(cfg)
+    mesh8 = build_mesh(MeshSpec(pp=2, dp=2, sp=1, tp=2), devices8)
+    step = make_hybrid_train_step(model, opt, mesh8, attn_impl="ring", n_microbatches=2)
+    params, opt_state = init_hybrid(model, opt, mesh8, seed=0)
+    params, opt_state, _ = step(params, opt_state, x, y)
+
+    stacked_wqkv = np.asarray(
+        jax.device_get(params["layers"]["attn"]["wqkv"])
+    )  # [n_layer, ...]
+    # lose one dp REPLICA (mesh layout [pp=2, dp=2, tp=2] → dp=1 ranks are
+    # devices {2,3,6,7}): every pp/tp shard keeps a survivor copy. Losing
+    # devices8[4:] instead would tear off pipeline stage 1 wholesale — the
+    # audit rightly refuses that (covered in test_require_full_state below).
+    lost = [devices8[i] for i in (2, 3, 6, 7)]
+    survivors = [devices8[i] for i in (0, 1, 4, 5)]
+    state = reconfigure(
+        model, opt, params, opt_state, surviving_devices=survivors, lost_devices=lost,
+    )
+    assert state.spec.pp == 1
+    assert isinstance(state.params["layers"], list)
+    for i, layer in enumerate(state.params["layers"]):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(layer["attn"]["wqkv"])), stacked_wqkv[i]
+        )
+    # adam mu followed the same transform (nonzero after one step)
+    mu = state.opt_state[0].mu
+    assert isinstance(mu["layers"], list)
+    assert float(np.abs(np.asarray(jax.device_get(mu["layers"][0]["attn"]["wqkv"]))).max()) > 0
+
+    # and the new mesh trains
+    step2 = make_hybrid_train_step(model, opt, state.mesh, attn_impl="ring")
+    _, _, loss = step2(state.params, state.opt_state, x, y)
+    assert np.isfinite(float(loss))
+
+
+def test_check_recoverable_replicated_survives(devices8):
+    mesh = Mesh(np.asarray(devices8), ("dev",))
+    x = jax.device_put(jnp.ones((16, 4)), NamedSharding(mesh, P()))  # replicated
+    assert check_recoverable({"w": x}, lost_devices=devices8[4:]) == []
+
+
+def test_check_recoverable_sharded_torn(devices8):
+    mesh = Mesh(np.asarray(devices8), ("dev",))
+    x = jax.device_put(jnp.ones((16, 4)), NamedSharding(mesh, P("dev")))  # sharded
+    torn = check_recoverable({"w": x}, lost_devices=devices8[4:])
+    assert torn and "only on lost devices" in torn[0]
+
+
+def test_policy_no_shrink_fails_fast(devices8):
+    model = GPT2(GPT2Config.tiny())
+    with pytest.raises(RuntimeError, match="allow_shrink=False"):
+        reconfigure(
+            model, optax.adam(1e-3), {}, (),
+            surviving_devices=devices8[:4], lost_devices=devices8[4:],
+            policy=ElasticPolicy(allow_shrink=False),
+        )
+
+
+def test_require_full_state_refuses_torn_state(devices8):
+    """Sharded-only state on lost devices → refuse to continue (checkpoint
+    fallback is the caller's move), rather than training on a torn state."""
+    mesh = Mesh(np.asarray(devices8), ("dev",))
+    model = GPT2(GPT2Config.tiny())
+    torn_params = {
+        "w": jax.device_put(jnp.ones((16, 4)), NamedSharding(mesh, P("dev")))
+    }
+    with pytest.raises(RuntimeError, match="not recoverable"):
+        reconfigure(
+            model, optax.adam(1e-3), torn_params, (),
+            surviving_devices=devices8[:4], lost_devices=devices8[4:],
+        )
+
+
+def test_awkward_survivor_count_idles_devices(devices8):
+    """5 survivors for a global batch of 4: the plan instantiates on the
+    largest workable subset (Oobleck: n-1 busy chips beat a crash)."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    opt = optax.adam(1e-2)
+    x, y = _data(cfg, n=4)
+    mesh8 = build_mesh(MeshSpec(dp=4, sp=1, tp=2), devices8)
+    step = make_hybrid_train_step(model, opt, mesh8, attn_impl="ring")
+    params, opt_state = init_hybrid(model, opt, mesh8, seed=0)
+    params, opt_state, _ = step(params, opt_state, x, y)
+
+    state = reconfigure(
+        model, opt, params, opt_state,
+        surviving_devices=devices8[:5], lost_devices=devices8[5:],
+        global_batch=x.shape[0],
+    )
+    total = state.spec.pp * state.spec.dp * state.spec.fsdp * state.spec.sp * state.spec.tp
+    assert total == 4 and x.shape[0] % state.spec.dp == 0
+    assert any("idle" in r for r in state.reasons)
+    step2 = make_hybrid_train_step(model, opt, state.mesh, attn_impl="ring")
+    _, _, loss = step2(state.params, state.opt_state, x, y)
+    assert np.isfinite(float(loss))
