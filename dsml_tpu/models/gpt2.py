@@ -63,6 +63,11 @@ class GPT2Config:
     # rows (ops/xent.py) instead of materializing [tokens, vocab] logits;
     # only kicks in when vocab_size > xent_chunk (0 disables)
     xent_chunk: int = 8192
+    # interleaved virtual pipeline stages (Megatron PTD-P): each pp rank
+    # holds this many non-contiguous layer chunks; >1 shrinks the pipeline
+    # bubble by the same factor (parallel.pp.pipeline_apply_interleaved).
+    # Requires n_layer divisible by pp×pp_interleave; gpipe schedule only
+    pp_interleave: int = 1
 
     @staticmethod
     def small() -> "GPT2Config":
@@ -339,16 +344,29 @@ class GPT2:
         h = self._embed_spmd(params, tokens, tp_axis, sp_axis, seq_offset)
 
         if pp_axis:
-            from dsml_tpu.parallel.pp import pipeline_apply
+            from dsml_tpu.parallel.pp import pipeline_apply, pipeline_apply_interleaved
 
             b = h.shape[0]
             if b % n_micro:
                 raise ValueError(f"per-rank batch {b} not divisible by n_micro={n_micro}")
             micro = h.reshape(n_micro, b // n_micro, *h.shape[1:])
-            # remat at STAGE granularity (one checkpoint per tick) rather
-            # than per block — the coarser cut bounds in-flight activations
-            # the way 1F1B does
-            outs = pipeline_apply(block, params["layers"], micro, pp_axis, remat=cfg.remat)
+            if cfg.pp_interleave > 1:
+                # local stacked layers = this rank's v chunks concatenated
+                # (init_hybrid permuted the layer order before sharding);
+                # reshape the leading axis to [v, layers_per_chunk]
+                v = cfg.pp_interleave
+                chunks = jax.tree.map(
+                    lambda p: p.reshape(v, p.shape[0] // v, *p.shape[1:]),
+                    params["layers"],
+                )
+                outs = pipeline_apply_interleaved(
+                    block, chunks, micro, v, pp_axis, remat=cfg.remat
+                )
+            else:
+                # remat at STAGE granularity (one checkpoint per tick) rather
+                # than per block — the coarser cut bounds in-flight activations
+                # the way 1F1B does
+                outs = pipeline_apply(block, params["layers"], micro, pp_axis, remat=cfg.remat)
             h = outs.reshape(b, *h.shape[1:])
         else:
             if cfg.remat == "int8":

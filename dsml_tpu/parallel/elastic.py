@@ -180,19 +180,41 @@ def reconfigure(
     pspecs = model.param_specs(pp=plan.spec.pp > 1)
     host_params = jax.device_get(params)
     host_opt = jax.device_get(opt_state)
-    if old_pp and plan.spec.pp == 1:
-        # the failed mesh ran a pipeline (stacked layer axis); the new plan
-        # doesn't — unstack params back to the per-layer list form, and
+    if old_pp:
+        # the failed mesh ran a pipeline (stacked layer axis, possibly in
+        # interleave-permuted order for the OLD stage count) — always return
+        # to the canonical per-layer list form first; if the new plan keeps
+        # a pipeline it restacks for the NEW stage count below. Skipping
+        # this when old and new pp happen to match would still be wrong
+        # whenever v>1 and the stage count changed. Unstack params, and
         # apply the SAME transform to every params-shaped subtree of the
         # optimizer state (adam's mu/nu mirror the param tree)
         n_layer = jax.tree.leaves(host_params["layers"])[0].shape[0]
+        # interleaved pipelines stacked the layers in chunk-permuted order
+        # (hybrid.init_hybrid); invert it so the list comes back in model order
+        v = getattr(cfg, "pp_interleave", 1)
+        if v > 1:
+            from dsml_tpu.parallel.pp import interleave_layer_order
+
+            old_pp_size = None
+            for leaf, sharding in _leaf_shardings(params):
+                if isinstance(sharding, NamedSharding) and "pp" in sharding.mesh.shape:
+                    old_pp_size = sharding.mesh.shape["pp"]
+                    break
+            order = interleave_layer_order(n_layer, old_pp_size or 1, v)
+            inverse = [0] * n_layer
+            for pos, orig in enumerate(order):
+                inverse[orig] = pos
+        else:
+            inverse = list(range(n_layer))
 
         def unstack(node):
             if isinstance(node, dict):
                 if "layers" in node and isinstance(node["layers"], dict):
-                    layers = [
+                    permuted = [
                         jax.tree.map(lambda l: l[i], node["layers"]) for i in range(n_layer)
                     ]
+                    layers = [permuted[inverse[i]] for i in range(n_layer)]
                     return {
                         **{k: unstack(v) for k, v in node.items() if k != "layers"},
                         "layers": layers,
@@ -207,6 +229,38 @@ def reconfigure(
 
         host_params = unstack(host_params)
         host_opt = unstack(host_opt)
+    if plan.spec.pp > 1:
+        # new plan keeps a pipeline: restack (in the new stage count's
+        # interleave order when v>1) — today's planner never emits pp>1,
+        # but the state transform must not silently depend on that
+        from dsml_tpu.parallel.pp import interleave_layer_order, stack_layer_params
+
+        v_new = getattr(cfg, "pp_interleave", 1)
+        n_layer = len(host_params["layers"])
+        order_new = (
+            interleave_layer_order(n_layer, plan.spec.pp, v_new)
+            if v_new > 1
+            else list(range(n_layer))
+        )
+
+        def restack(node):
+            if isinstance(node, dict):
+                if "layers" in node and isinstance(node["layers"], list):
+                    layers = stack_layer_params([node["layers"][i] for i in order_new])
+                    return {
+                        **{k: restack(v) for k, v in node.items() if k != "layers"},
+                        "layers": layers,
+                    }
+                return {k: restack(v) for k, v in node.items()}
+            if isinstance(node, tuple):
+                mapped = [restack(v) for v in node]
+                return type(node)(*mapped) if hasattr(node, "_fields") else tuple(mapped)
+            if isinstance(node, list):
+                return [restack(v) for v in node]
+            return node
+
+        host_params = restack(host_params)
+        host_opt = restack(host_opt)
     from dsml_tpu.parallel.hybrid import shard_params
 
     new_params = shard_params(host_params, new_mesh, pspecs)

@@ -90,6 +90,8 @@ def make_hybrid_train_step(
         # silent fallback would let a user "measure 1F1B" on a pipeline-less
         # mesh and actually measure the gpipe path
         raise ValueError("schedule='1f1b' requires a mesh with pp > 1")
+    if schedule == "1f1b" and getattr(model.config, "pp_interleave", 1) > 1:
+        raise ValueError("pp_interleave > 1 composes with the gpipe schedule only")
     pspecs = model.param_specs(pp=bool(pp_axis))
     batch_spec = P("dp", "sp")
     loss_fn = hybrid_loss_fn(model, attn_impl, pp_axis, n_microbatches)
@@ -189,13 +191,21 @@ def init_hybrid(model, optimizer, mesh: Mesh, seed: int = 0):
     params = model.init(seed)
     pp = mesh.shape.get("pp", 1) > 1
     if pp:
-        from dsml_tpu.parallel.pp import stack_layer_params
+        from dsml_tpu.parallel.pp import interleave_layer_order, stack_layer_params
 
         n_layer = len(params["layers"])
         pp_size = mesh.shape["pp"]
         if n_layer % pp_size:
             raise ValueError(f"n_layer={n_layer} not divisible by pp={pp_size}")
-        params = {**params, "layers": stack_layer_params(params["layers"])}
+        v = getattr(model.config, "pp_interleave", 1)
+        layers = params["layers"]
+        if v > 1:
+            # interleaved schedule: rank r owns chunks r, r+S, … — permute
+            # the layer order so the plain P('pp') shard hands each rank
+            # exactly its v chunks (pp.interleave_layer_order)
+            order = interleave_layer_order(n_layer, pp_size, v)
+            layers = [layers[i] for i in order]
+        params = {**params, "layers": stack_layer_params(layers)}
     params = shard_params(params, mesh, model.param_specs(pp=pp))
     opt_state = jax.jit(optimizer.init)(params)
     return params, opt_state

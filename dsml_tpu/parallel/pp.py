@@ -22,7 +22,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["pipeline_apply", "pipeline_train_1f1b", "stack_layer_params", "pipeline_specs"]
+__all__ = [
+    "pipeline_apply",
+    "pipeline_apply_interleaved",
+    "pipeline_train_1f1b",
+    "stack_layer_params",
+    "pipeline_specs",
+    "interleave_layer_order",
+]
 
 
 def stack_layer_params(layer_params: list) -> dict:
@@ -123,6 +130,130 @@ def pipeline_apply(
     (_, outputs), _ = lax.scan(tick, (buf0, out0), jnp.arange(ticks))
     # outputs are resident on the last stage only; replicate so every rank
     # (e.g. a colocated loss/unembed) can proceed
+    return lax.psum(jnp.where(rank == n_stage - 1, outputs, 0.0), axis)
+
+
+def interleave_layer_order(n_layer: int, n_stage: int, v: int) -> list[int]:
+    """Layer permutation for the interleaved schedule: rank r owns virtual
+    chunks r, r+S, …, r+(v−1)S (Megatron PTD-P's round-robin assignment), so
+    the stacked layer axis must be reordered before sharding it ``P('pp')``
+    — position ``r·(n_layer/S) + j·(n_layer/(vS)) + i`` gets original layer
+    ``(r + jS)·(n_layer/(vS)) + i``."""
+    if n_layer % (n_stage * v):
+        raise ValueError(f"n_layer={n_layer} not divisible by stages×interleave={n_stage * v}")
+    per_chunk = n_layer // (n_stage * v)
+    order = []
+    for r in range(n_stage):
+        for j in range(v):
+            chunk = r + j * n_stage
+            order.extend(range(chunk * per_chunk, (chunk + 1) * per_chunk))
+    return order
+
+
+def pipeline_apply_interleaved(
+    layer_fn: Callable,
+    stage_params,
+    microbatches: jax.Array,
+    v: int,
+    axis: str = "pp",
+    remat: bool | str = False,
+) -> jax.Array:
+    """Interleaved virtual-stage pipeline (Megatron PTD-P's interleaved
+    schedule — the same `2104.04473v5.pdf` in the reference's §3 Hybrid
+    literature whose tensor sharding ``models.gpt2`` implements). Each rank
+    holds ``v`` non-contiguous layer CHUNKS (chunks r, r+S, …, r+(v−1)S);
+    a microbatch hops the ring v times, visiting chunks in order. The fill/
+    drain bubble is S−1 ticks of CHUNK work instead of GPipe's S−1 ticks of
+    full-stage work — v× smaller, the schedule's whole point.
+
+    SPMD formulation: microbatches are injected in groups of S spaced S·v
+    ticks apart. Under that spacing each in-flight work unit (microbatch m,
+    chunk k) advances exactly one hop per tick with no rank ever owing two
+    units in the same tick — so the whole schedule is one ``lax.scan`` with
+    a single carry buffer and a full-ring ``ppermute`` (the S−1→0 edge
+    carries chunk k → k+1 wraparound traffic), total ticks M·v + S − 1.
+    Closed form per (tick t, rank r): with q = (t−r−((t−r) mod S))/S, the
+    active unit is chunk index j = q mod v, microbatch
+    m = ((t−r) mod S) + S·(q div v).
+
+    ``stage_params`` — this rank's chunks, leading axes [v, layers_per_chunk]
+    (stack with :func:`stack_layer_params` after permuting layers by
+    :func:`interleave_layer_order`, shard ``P('pp')``, then reshape the
+    local leading axis S·v/S → [v, per_chunk] inside the caller's shard_map
+    — :meth:`models.gpt2.GPT2._blocks_spmd` shows the dance).
+    ``microbatches`` — [M, micro, ...] with M divisible by S.
+    Returns [M, micro, ...], replicated (same contract as
+    :func:`pipeline_apply`).
+    """
+    n_stage = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    n_micro = microbatches.shape[0]
+    if n_micro % n_stage:
+        raise ValueError(
+            f"interleaved schedule needs microbatches divisible by stages: {n_micro} % {n_stage}"
+        )
+    if remat not in (False, True, "int8"):
+        raise ValueError(f"unknown remat mode {remat!r}; choose False, True, or 'int8'")
+    if not isinstance(remat, str):
+        remat = bool(remat)  # 1 passes validation (1 == True); normalize so
+        # the `remat is True` dispatch below can't silently drop remat
+    if remat == "int8":
+        from dsml_tpu.ops.quantization import compressed_checkpoint
+
+        layer_fn = compressed_checkpoint(layer_fn)
+
+    def chunk_fn(chunk_params, x):
+        def body(h, one_layer):
+            return layer_fn(one_layer, h), None
+
+        out, _ = lax.scan(body, x, chunk_params)
+        return out
+
+    if remat is True:
+        chunk_fn = jax.checkpoint(chunk_fn)
+
+    if n_stage == 1:
+        # v chunks on one rank = the plain layer stack
+        def all_chunks(x):
+            def body(h, chunk):
+                return chunk_fn(chunk, h), None
+
+            out, _ = lax.scan(body, x, stage_params)
+            return out
+
+        return jax.vmap(all_chunks)(microbatches)
+
+    groups = n_micro // n_stage
+    ticks = n_micro * v + n_stage - 1
+    ring = [(i, (i + 1) % n_stage) for i in range(n_stage)]  # full ring: S−1→0 wraps chunks
+
+    def tick(carry, t):
+        buf, outputs = carry
+        rel = t - rank
+        mmod = jnp.remainder(rel, n_stage)
+        q = (rel - mmod) // n_stage
+        j = jnp.remainder(q, v)  # which of this rank's v chunks
+        g = q // v  # microbatch group
+        m = mmod + n_stage * g
+        active = (rel >= 0) & (g >= 0) & (g < groups)
+        slot = jnp.clip(m, 0, n_micro - 1)
+
+        # rank 0's chunk 0 (j==0) ingests micro m; everything else consumes
+        # the ring hop (which already carries chunk k−1's output for unit m)
+        feed = microbatches[slot]
+        x_in = jnp.where((rank == 0) & (j == 0), feed, buf)
+        chunk = jax.tree.map(lambda p: p[j], stage_params)
+        y = jnp.where(active, chunk_fn(chunk, x_in), jnp.zeros_like(x_in))
+
+        # last rank's last chunk (j==v−1) completes micro m
+        write = (rank == n_stage - 1) & (j == v - 1) & active
+        outputs = outputs.at[slot].set(jnp.where(write, y, outputs[slot]))
+        buf = lax.ppermute(y, axis, ring)
+        return (buf, outputs), None
+
+    buf0 = jnp.zeros_like(microbatches[0])
+    out0 = jnp.zeros_like(microbatches)
+    (_, outputs), _ = lax.scan(tick, (buf0, out0), jnp.arange(ticks))
     return lax.psum(jnp.where(rank == n_stage - 1, outputs, 0.0), axis)
 
 
