@@ -1,3 +1,3 @@
-"""Model families: MLP (MNIST), CNN, ResNet-18 (CIFAR-10), GPT-2."""
+"""Model families: MLP (MNIST), CNN, ResNet-18 (CIFAR-10), GPT-2, Llama."""
 
 from dsml_tpu.models.mlp import MLP  # noqa: F401

@@ -255,7 +255,7 @@ class GPT2:
         across pp ranks.
         """
         h = self._hidden_spmd(params, tokens, tp_axis, sp_axis, attn_impl, seq_offset, pp_axis, n_micro)
-        return h @ params["wte"].T  # tied unembedding → [b, s, vocab/tp]
+        return h @ self._unembed_matrix(params).T  # unembedding → [b, s, vocab/tp]
 
     def _head_loss_spmd(self, params, h_raw, targets, tp_axis=None):
         """Final norm + tied unembedding + next-token CE for PRE-final-norm
@@ -263,7 +263,7 @@ class GPT2:
         pipeline's last stage owns; shared by :meth:`loss_spmd` and the 1F1B
         schedule (which must run it per microbatch, inside the schedule)."""
         cfg = self.config
-        h = _layer_norm(h_raw, **params["ln_f"])
+        h = self._final_norm(params, h_raw)
         tp_size = lax.axis_size(tp_axis) if tp_axis else 1
         if tp_size == 1:
             if cfg.xent_chunk and cfg.vocab_size > cfg.xent_chunk:
@@ -271,12 +271,12 @@ class GPT2:
                 # vocab] logits never exist (ops/xent.py)
                 from dsml_tpu.ops.xent import chunked_softmax_xent
 
-                return chunked_softmax_xent(h, params["wte"], targets, cfg.xent_chunk)
-            logits = (h @ params["wte"].T).astype(jnp.float32)
+                return chunked_softmax_xent(h, self._unembed_matrix(params), targets, cfg.xent_chunk)
+            logits = (h @ self._unembed_matrix(params).T).astype(jnp.float32)
             logp = jax.nn.log_softmax(logits)
             nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
             return nll.mean()
-        logits = (h @ params["wte"].T).astype(jnp.float32)
+        logits = (h @ self._unembed_matrix(params).T).astype(jnp.float32)
         vocab_shard = logits.shape[-1]
         tp_rank = lax.axis_index(tp_axis)
         # distributed logsumexp (max-shift carries no gradient, and pmax has
@@ -388,7 +388,7 @@ class GPT2:
         h = self._blocks_spmd(
             params, tokens, tp_axis, sp_axis, attn_impl, seq_offset, pp_axis, n_micro
         )
-        return _layer_norm(h, **params["ln_f"])
+        return self._final_norm(params, h)
 
     def _block(self, layer, h, n_head_local, tp_axis, sp_axis, attn_impl):
         """One transformer block (pre-LN attention + MLP/MoE residuals) —
@@ -402,36 +402,39 @@ class GPT2:
 
     _ATTN_IMPLS = ("ring", "ulysses", "ulysses_flash", "ring_flash", "flash", "xla")
 
-    def _attn_block(self, layer, h, n_head_local, tp_axis, sp_axis, attn_impl):
+    def _route_attention(self, q, k, v, sp_axis, attn_impl):
+        """[b, h_local, s, hd] q/k/v → causal attention output, routed to the
+        impl that is CORRECT for the sharding (shared by GPT-2 and Llama)."""
         if attn_impl not in self._ATTN_IMPLS:
             # a typo would otherwise silently train on the ring/XLA fallback
             raise ValueError(f"unknown attn_impl {attn_impl!r}; choose from {self._ATTN_IMPLS}")
-        x = _layer_norm(h, **layer["ln_1"])
-        q, k, v = self._qkv_heads(layer, x, n_head_local)
         if sp_axis:
             # sequence is sharded: only ring/Ulysses see the full context.
             # Anything else (incl. "flash", a single-chip kernel) would be
             # silently-wrong block-diagonal attention — route it to ring.
             if attn_impl == "ulysses":
-                out = ulysses_attention(q, k, v, sp_axis, causal=True)
-            elif attn_impl == "ulysses_flash":
-                out = ulysses_attention(q, k, v, sp_axis, causal=True, flash=True)
-            elif attn_impl == "ring_flash":
+                return ulysses_attention(q, k, v, sp_axis, causal=True)
+            if attn_impl == "ulysses_flash":
+                return ulysses_attention(q, k, v, sp_axis, causal=True, flash=True)
+            if attn_impl == "ring_flash":
                 from dsml_tpu.ops.flash import ring_flash_attention
 
-                out = ring_flash_attention(q, k, v, sp_axis, causal=True)
-            else:
-                out = ring_attention(q, k, v, sp_axis, causal=True)
-        elif attn_impl in ("flash", "ring_flash", "ulysses_flash"):
+                return ring_flash_attention(q, k, v, sp_axis, causal=True)
+            return ring_attention(q, k, v, sp_axis, causal=True)
+        if attn_impl in ("flash", "ring_flash", "ulysses_flash"):
             # no sp axis → every flash variant degenerates to the
             # single-chip kernel (falling through to plain attention would
             # materialize the [seq, seq] scores the caller chose flash to
             # avoid)
             from dsml_tpu.ops.flash import flash_attention
 
-            out = flash_attention(q, k, v, causal=True)
-        else:
-            out = attention(q, k, v, causal=True)
+            return flash_attention(q, k, v, causal=True)
+        return attention(q, k, v, causal=True)
+
+    def _attn_block(self, layer, h, n_head_local, tp_axis, sp_axis, attn_impl):
+        x = _layer_norm(h, **layer["ln_1"])
+        q, k, v = self._qkv_heads(layer, x, n_head_local)
+        out = self._route_attention(q, k, v, sp_axis, attn_impl)
         out = self._merge_heads(out) @ layer["attn"]["wo"]  # row-parallel → partial sums
         if tp_axis:
             out = lax.psum(out, tp_axis)  # Megatron psum #1
@@ -702,6 +705,15 @@ class GPT2:
         b, _, s, _ = t.shape
         return t.transpose(0, 2, 1, 3).reshape(b, s, -1)
 
+    def _final_norm(self, params, h):
+        """Pre-head normalization hook (Llama: RMSNorm over rms_f)."""
+        return _layer_norm(h, **params["ln_f"])
+
+    def _unembed_matrix(self, params):
+        """[vocab(/tp), d] unembedding hook — GPT-2 ties it to wte; Llama
+        overrides with the untied lm_head."""
+        return params["wte"]
+
     def _ffn(self, layer, h, tp_axis=None):
         if self.config.n_experts:
             return h + self._moe_block(layer["moe"], _layer_norm(h, **layer["ln_2"]), tp_axis)
@@ -712,10 +724,34 @@ class GPT2:
         vocab-sharded; decode needs the whole row for sampling, so the local
         [..., vocab/tp] shards all_gather over tp (tiny at decode batch
         sizes — [batch, vocab], not [tokens, vocab])."""
-        local = h @ params["wte"].T
+        local = h @ self._unembed_matrix(params).T
         if tp_axis:
             return lax.all_gather(local, tp_axis, axis=-1, tiled=True)
         return local
+
+    # Serving hooks — ONE prefill/decode loop serves every model family;
+    # subclasses override only the architecture-specific pieces (Llama:
+    # RMSNorm, RoPE'd GQA projections, grouped cache attention, no biases).
+
+    def _norm1(self, layer, h):
+        return _layer_norm(h, **layer["ln_1"])
+
+    def _attn_out_bias(self, layer):
+        return layer["attn"]["bo"]
+
+    def _serving_qkv(self, layer, x, positions, tp_size):
+        """(q, k_cache, v_cache, k_attn, v_attn) for the serving path.
+        ``positions`` [s] are the global token positions of ``x`` (ignored
+        here — GPT-2 positions live in wpe; Llama applies RoPE)."""
+        q, k, v = self._qkv_heads(layer, x, self.config.n_head // tp_size)
+        return q, k, v, k, v
+
+    def _decode_attention(self, q, ck, cv, valid):
+        """q [b, H, 1, hd] against the full cache [b, Hc, S, hd] (H == Hc
+        here; Llama overrides with the grouped-query form)."""
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck) * (q.shape[-1] ** -0.5)
+        scores = jnp.where(valid[None, None, None, :], scores, _NEG_INF)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), cv)
 
     def prefill(self, params: dict, tokens: jax.Array, tp_axis: str | None = None):
         """Run the prompt [batch, T] in ONE pass, filling the cache.
@@ -724,55 +760,52 @@ class GPT2:
         With ``tp_axis`` (call under shard_map with Megatron-sharded
         params), the pass is head-parallel: local-head attention + one psum
         per block pair, vocab-sharded embed/unembed, per-rank cache shard."""
-        cfg = self.config
         b, t = tokens.shape
         tp_size = lax.axis_size(tp_axis) if tp_axis else 1
-        n_head_local = cfg.n_head // tp_size
+        positions = jnp.arange(t, dtype=jnp.int32)
         h = self._embed_spmd(params, tokens, tp_axis)
         cache = self.init_cache(b, tp_size)
         for i, layer in enumerate(params["layers"]):
-            x = _layer_norm(h, **layer["ln_1"])
-            q, k, v = self._qkv_heads(layer, x, n_head_local)
-            out = attention(q, k, v, causal=True)
+            x = self._norm1(layer, h)
+            q, kc, vc, ka, va = self._serving_qkv(layer, x, positions, tp_size)
+            out = attention(q, ka, va, causal=True)
             attn_out = self._merge_heads(out) @ layer["attn"]["wo"]
             if tp_axis:
                 attn_out = lax.psum(attn_out, tp_axis)
-            h = h + attn_out + layer["attn"]["bo"]
+            h = h + attn_out + self._attn_out_bias(layer)
             h = self._ffn(layer, h, tp_axis)
             cache[i] = {
-                "k": lax.dynamic_update_slice(cache[i]["k"], k, (0, 0, 0, 0)),
-                "v": lax.dynamic_update_slice(cache[i]["v"], v, (0, 0, 0, 0)),
+                "k": lax.dynamic_update_slice(cache[i]["k"], kc, (0, 0, 0, 0)),
+                "v": lax.dynamic_update_slice(cache[i]["v"], vc, (0, 0, 0, 0)),
             }
-        h = _layer_norm(h, **params["ln_f"])
+        h = self._final_norm(params, h)
         return self._unembed_full(params, h[:, -1], tp_axis), cache
 
     def decode_step(
         self, params: dict, cache: list, tokens: jax.Array, pos: jax.Array,
         tp_axis: str | None = None,
     ):
-        """One decode step: ``tokens`` [batch] at position ``pos`` (scalar).
-        Returns (logits [batch, vocab], updated cache)."""
+        """One decode step: ``tokens`` [batch] at position ``pos`` (scalar,
+        int or traced). Returns (logits [batch, vocab], updated cache)."""
         cfg = self.config
         tp_size = lax.axis_size(tp_axis) if tp_axis else 1
-        n_head_local = cfg.n_head // tp_size
+        positions = jnp.reshape(jnp.asarray(pos, jnp.int32), (1,))
         h = self._embed_spmd(params, tokens[:, None], tp_axis, seq_offset=pos)
         valid = jnp.arange(cfg.max_seq) <= pos  # attend to cache[0..pos]
         new_cache = []
         for layer, c in zip(params["layers"], cache):
-            x = _layer_norm(h, **layer["ln_1"])
-            q, k, v = self._qkv_heads(layer, x, n_head_local)  # [b, H_local, 1, hd]
-            ck = lax.dynamic_update_slice(c["k"], k, (0, 0, pos, 0))
-            cv = lax.dynamic_update_slice(c["v"], v, (0, 0, pos, 0))
-            scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck) * (q.shape[-1] ** -0.5)
-            scores = jnp.where(valid[None, None, None, :], scores, _NEG_INF)
-            out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), cv)
+            x = self._norm1(layer, h)
+            q, kc, vc, _, _ = self._serving_qkv(layer, x, positions, tp_size)
+            ck = lax.dynamic_update_slice(c["k"], kc, (0, 0, pos, 0))
+            cv = lax.dynamic_update_slice(c["v"], vc, (0, 0, pos, 0))
+            out = self._decode_attention(q, ck, cv, valid)
             attn_out = self._merge_heads(out) @ layer["attn"]["wo"]
             if tp_axis:
                 attn_out = lax.psum(attn_out, tp_axis)
-            h = h + attn_out + layer["attn"]["bo"]
+            h = h + attn_out + self._attn_out_bias(layer)
             h = self._ffn(layer, h, tp_axis)
             new_cache.append({"k": ck, "v": cv})
-        h = _layer_norm(h, **params["ln_f"])
+        h = self._final_norm(params, h)
         return self._unembed_full(params, h[:, 0], tp_axis), new_cache
 
     def generate(

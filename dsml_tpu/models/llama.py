@@ -1,0 +1,354 @@
+"""Llama-family decoder — the second transformer family on the same mesh
+program infrastructure.
+
+The reference never got past an MLP (SURVEY.md §2.3); GPT-2 realizes its
+literature roadmap, and this module demonstrates the framework claim that
+matters beyond any one model: the parallelism stack (Megatron TP psums,
+ring/Ulysses/2D/flash sequence parallelism, GPipe/interleaved/1F1B
+pipelines, FSDP, elastic reconfigure) is MODEL-GENERIC. Llama subclasses
+:class:`~dsml_tpu.models.gpt2.GPT2` and overrides only the architecture:
+
+- **RMSNorm** instead of LayerNorm (no mean-centering, no bias).
+- **RoPE** rotary position embeddings applied to q/k inside attention — no
+  learned position table; under sequence parallelism each sp rank rotates by
+  its GLOBAL positions (rank · s_local offset), so ring/Ulysses attention
+  stays exact.
+- **SwiGLU** MLP: ``silu(x·w_gate) ⊙ (x·w_up) · w_down`` — gate/up
+  column-sharded, down row-sharded (same Megatron psum points as GPT-2).
+- **GQA** (grouped-query attention): ``n_kv_head ≤ n_head`` K/V heads,
+  repeated to query heads for the shared attention impls; the KV cache holds
+  only the kv heads (the GQA serving memory win). TP requires
+  ``n_kv_head % tp == 0``.
+- **Untied unembedding** (``lm_head``), vocab-sharded like ``wte``.
+
+Everything else — ``loss_spmd`` (vocab-sharded CE / chunked xent), pipeline
+integration (``pp_interleave`` included), remat modes (incl. ``"int8"``
+compressed), ``generate``/``generate_spmd`` serving, 1F1B — is inherited
+unchanged: the subclass overrides the layer math, the mesh machinery never
+notices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dsml_tpu.models.gpt2 import GPT2
+from dsml_tpu.ops.attention import _NEG_INF
+
+__all__ = ["LlamaConfig", "Llama"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_seq: int = 2048
+    n_layer: int = 22
+    n_head: int = 32
+    n_kv_head: int = 4  # GQA: kv heads grouped under query heads
+    d_model: int = 2048
+    d_ff: int = 5632  # SwiGLU hidden width
+    dtype: str = "float32"
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    # shared-plumbing knobs (read by the inherited GPT2 machinery)
+    n_experts: int = 0  # Llama is dense; kept 0 so inherited paths stay dense
+    remat: bool | str = False
+    xent_chunk: int = 8192
+    pp_interleave: int = 1
+
+    @staticmethod
+    def tinyllama_1b() -> "LlamaConfig":
+        """TinyLlama-1.1B shape (22×2048, GQA 32q/4kv)."""
+        return LlamaConfig()
+
+    @staticmethod
+    def llama2_7b() -> "LlamaConfig":
+        return LlamaConfig(
+            n_layer=32, n_head=32, n_kv_head=32, d_model=4096, d_ff=11008, max_seq=4096
+        )
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256, n_layer=32, n_head=32, n_kv_head=8, d_model=4096,
+            d_ff=14336, max_seq=8192, rope_theta=500000.0,
+        )
+
+    @classmethod
+    def by_name(cls, name: str, **tiny_kwargs) -> "LlamaConfig":
+        presets = {
+            "tiny": cls.tiny,
+            "tinyllama_1b": cls.tinyllama_1b,
+            "llama2_7b": cls.llama2_7b,
+            "llama3_8b": cls.llama3_8b,
+        }
+        if name not in presets:
+            raise ValueError(f"unknown Llama preset {name!r}; choose from {sorted(presets)}")
+        return presets[name](**tiny_kwargs) if name == "tiny" else presets[name]()
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "LlamaConfig":
+        """Test-sized config exercising GQA (8q/2kv), RoPE, SwiGLU."""
+        return LlamaConfig(
+            vocab_size=vocab_size, max_seq=128, n_layer=2, n_head=8, n_kv_head=2,
+            d_model=64, d_ff=128,
+        )
+
+
+def _rms_norm(x, scale, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * scale
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding, rotate-half convention. ``x`` [b, h, s, hd],
+    ``positions`` [s] GLOBAL token positions (int32)."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) * 2.0 / hd)  # [half]
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [s, half]
+    cos = jnp.cos(angles)[None, None, :, :]
+    sin = jnp.sin(angles)[None, None, :, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., :half], x32[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class Llama(GPT2):
+    """Llama on the GPT-2 mesh scaffolding (see module docstring)."""
+
+    def __init__(self, config: LlamaConfig | None = None):
+        self.config = config or LlamaConfig.tinyllama_1b()
+
+    # ---- params ---------------------------------------------------------------
+
+    def init(self, seed: int = 0) -> dict:
+        cfg = self.config
+        rng = np.random.default_rng(seed)
+        dt = jnp.dtype(cfg.dtype)
+        hd = cfg.d_model // cfg.n_head
+        kv_d = cfg.n_kv_head * hd
+
+        def normal(*shape, std=0.02):
+            return jnp.asarray(rng.standard_normal(shape) * std, dt)
+
+        res_std = 0.02 / math.sqrt(2 * cfg.n_layer)
+        params = {
+            "wte": normal(cfg.vocab_size, cfg.d_model),
+            "lm_head": normal(cfg.vocab_size, cfg.d_model),
+            "rms_f": {"scale": jnp.ones(cfg.d_model, dt)},
+            "layers": [
+                {
+                    "rms_1": {"scale": jnp.ones(cfg.d_model, dt)},
+                    "rms_2": {"scale": jnp.ones(cfg.d_model, dt)},
+                    "attn": {
+                        "wq": normal(cfg.d_model, cfg.d_model),
+                        "wk": normal(cfg.d_model, kv_d),
+                        "wv": normal(cfg.d_model, kv_d),
+                        "wo": normal(cfg.d_model, cfg.d_model, std=res_std),
+                    },
+                    "mlp": {
+                        "w_gate": normal(cfg.d_model, cfg.d_ff),
+                        "w_up": normal(cfg.d_model, cfg.d_ff),
+                        "w_down": normal(cfg.d_ff, cfg.d_model, std=res_std),
+                    },
+                }
+                for _ in range(cfg.n_layer)
+            ],
+        }
+        return params
+
+    def param_specs(self, pp: bool = False) -> dict:
+        """Megatron sharding: q/k/v/gate/up column-parallel (head split for
+        q/k/v), wo/w_down row-parallel, vocab matrices vocab-sharded."""
+        from jax.sharding import PartitionSpec as P
+
+        cfg = self.config
+        layer_spec = {
+            "rms_1": {"scale": P()},
+            "rms_2": {"scale": P()},
+            "attn": {
+                "wq": P(None, "tp"),
+                "wk": P(None, "tp"),
+                "wv": P(None, "tp"),
+                "wo": P("tp", None),
+            },
+            "mlp": {
+                "w_gate": P(None, "tp"),
+                "w_up": P(None, "tp"),
+                "w_down": P("tp", None),
+            },
+        }
+        if pp:
+            from dsml_tpu.parallel.pp import pipeline_specs
+
+            layers = pipeline_specs(layer_spec, "pp")
+        else:
+            layers = [layer_spec for _ in range(cfg.n_layer)]
+        return {
+            "wte": P("tp", None),
+            "lm_head": P("tp", None),
+            "rms_f": {"scale": P()},
+            "layers": layers,
+        }
+
+    # ---- architecture hooks ---------------------------------------------------
+
+    def _final_norm(self, params, h):
+        return _rms_norm(h, params["rms_f"]["scale"], self.config.rms_eps)
+
+    def _unembed_matrix(self, params):
+        return params["lm_head"]
+
+    def _block_closure(self, tp_axis, sp_axis, attn_impl):
+        cfg = self.config
+        tp_size = lax.axis_size(tp_axis) if tp_axis else 1
+        if cfg.n_head % tp_size or cfg.n_kv_head % tp_size:
+            raise ValueError(
+                f"n_head={cfg.n_head}/n_kv_head={cfg.n_kv_head} not divisible by tp={tp_size}"
+            )
+        return super()._block_closure(tp_axis, sp_axis, attn_impl)
+
+    def _embed_spmd(self, params, tokens, tp_axis=None, sp_axis=None, seq_offset=None):
+        """Token embedding only — positions enter through RoPE, not a table."""
+        if tp_axis:
+            vocab_shard = params["wte"].shape[0]
+            tp_rank = lax.axis_index(tp_axis)
+            local_ids = tokens - tp_rank * vocab_shard
+            in_shard = (local_ids >= 0) & (local_ids < vocab_shard)
+            safe_ids = jnp.clip(local_ids, 0, vocab_shard - 1)
+            return lax.psum(params["wte"][safe_ids] * in_shard[..., None], tp_axis)
+        return params["wte"][tokens]
+
+    def _qkv_gqa(self, layer, x, n_head_local, n_kv_local, positions):
+        """Separate q/k/v projections, head split, RoPE on q/k, kv-head
+        repeat to the query head count (GQA → the shared attention impls see
+        MHA shapes)."""
+        hd = self.config.d_model // self.config.n_head
+
+        def heads(t, n):
+            b, s, _ = t.shape
+            return t.reshape(b, s, n, hd).transpose(0, 2, 1, 3)
+
+        q = heads(x @ layer["attn"]["wq"], n_head_local)
+        k = heads(x @ layer["attn"]["wk"], n_kv_local)
+        v = heads(x @ layer["attn"]["wv"], n_kv_local)
+        q = _rope(q, positions, self.config.rope_theta)
+        k = _rope(k, positions, self.config.rope_theta)
+        repeat = n_head_local // n_kv_local
+        if repeat > 1:
+            k = jnp.repeat(k, repeat, axis=1)
+            v = jnp.repeat(v, repeat, axis=1)
+        return q, k, v
+
+    def _block(self, layer, h, n_head_local, tp_axis, sp_axis, attn_impl):
+        cfg = self.config
+        n_kv_local = n_head_local * cfg.n_kv_head // cfg.n_head
+        s_local = h.shape[1]
+        # global positions: this sp rank's sequence shard starts at rank·s_local
+        offset = lax.axis_index(sp_axis) * s_local if sp_axis else 0
+        positions = offset + jnp.arange(s_local, dtype=jnp.int32)
+
+        x = _rms_norm(h, layer["rms_1"]["scale"], cfg.rms_eps)
+        q, k, v = self._qkv_gqa(layer, x, n_head_local, n_kv_local, positions)
+        out = self._route_attention(q, k, v, sp_axis, attn_impl)
+        out = self._merge_heads(out) @ layer["attn"]["wo"]
+        if tp_axis:
+            out = lax.psum(out, tp_axis)
+        h = h + out
+        h = h + self._mlp_block(layer["mlp"], _rms_norm(h, layer["rms_2"]["scale"], cfg.rms_eps), tp_axis)
+        return h
+
+    def _mlp_block(self, mlp, x, tp_axis):
+        mid = jax.nn.silu(x @ mlp["w_gate"]) * (x @ mlp["w_up"])  # [b, s, ff/tp]
+        out = mid @ mlp["w_down"]
+        if tp_axis:
+            out = lax.psum(out, tp_axis)  # Megatron psum #2
+        return out
+
+    def _ffn(self, layer, h, tp_axis=None):
+        return h + self._mlp_block(
+            layer["mlp"], _rms_norm(h, layer["rms_2"]["scale"], self.config.rms_eps), tp_axis
+        )
+
+    def _hidden_spmd(
+        self, params, tokens, tp_axis=None, sp_axis=None, attn_impl="ring",
+        seq_offset=None, pp_axis=None, n_micro=1,
+    ):
+        if seq_offset is not None:
+            # GPT-2 realizes seq_offset through its wpe table; Llama positions
+            # enter via RoPE inside _block, which derives them from the sp
+            # rank — an externally supplied offset would be silently ignored
+            raise ValueError(
+                "Llama forward does not take seq_offset (RoPE positions derive "
+                "from the sp shard); use prefill/decode_step for offset decoding"
+            )
+        return super()._hidden_spmd(
+            params, tokens, tp_axis, sp_axis, attn_impl, None, pp_axis, n_micro
+        )
+
+    # ---- serving hooks (KV cache holds kv heads only — the GQA memory win) ----
+    # prefill/decode_step themselves are inherited: the base loops call these.
+
+    def init_cache(self, batch: int, tp_size: int = 1) -> list:
+        cfg = self.config
+        if cfg.n_kv_head % tp_size:
+            raise ValueError(f"n_kv_head={cfg.n_kv_head} not divisible by tp={tp_size}")
+        hd = cfg.d_model // cfg.n_head
+        n_kv_local = cfg.n_kv_head // tp_size
+        dt = jnp.dtype(cfg.dtype)
+        return [
+            {
+                "k": jnp.zeros((batch, n_kv_local, cfg.max_seq, hd), dt),
+                "v": jnp.zeros((batch, n_kv_local, cfg.max_seq, hd), dt),
+            }
+            for _ in range(cfg.n_layer)
+        ]
+
+    def _norm1(self, layer, h):
+        return _rms_norm(h, layer["rms_1"]["scale"], self.config.rms_eps)
+
+    def _attn_out_bias(self, layer):
+        return 0.0
+
+    def _serving_qkv(self, layer, x, positions, tp_size):
+        """RoPE'd q/k/v: cache forms keep the kv heads (GQA), attention
+        forms repeat them to the query head count."""
+        cfg = self.config
+        n_head_local = cfg.n_head // tp_size
+        n_kv_local = cfg.n_kv_head // tp_size
+        hd = cfg.d_model // cfg.n_head
+
+        def heads(t, n):
+            b, s, _ = t.shape
+            return t.reshape(b, s, n, hd).transpose(0, 2, 1, 3)
+
+        q = _rope(heads(x @ layer["attn"]["wq"], n_head_local), positions, cfg.rope_theta)
+        k = _rope(heads(x @ layer["attn"]["wk"], n_kv_local), positions, cfg.rope_theta)
+        v = heads(x @ layer["attn"]["wv"], n_kv_local)
+        repeat = n_head_local // n_kv_local
+        ka = jnp.repeat(k, repeat, axis=1) if repeat > 1 else k
+        va = jnp.repeat(v, repeat, axis=1) if repeat > 1 else v
+        return q, k, v, ka, va
+
+    def _decode_attention(self, q, ck, cv, valid):
+        """Grouped-query attention against the kv-head cache — query heads
+        grouped over their kv head, no materialized repeat."""
+        b, hq, s, hd = q.shape
+        repeat = hq // ck.shape[1]
+        qg = q.reshape(b, hq // repeat, repeat, s, hd)
+        scores = jnp.einsum(
+            "bgrqd,bgkd->bgrqk", qg.astype(jnp.float32), ck.astype(jnp.float32)
+        ) * (hd ** -0.5)
+        scores = jnp.where(valid[None, None, None, None, :], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bgrqk,bgkd->bgrqd", probs, cv.astype(jnp.float32))
+        return out.reshape(b, hq, s, hd).astype(q.dtype)

@@ -143,7 +143,9 @@ class Trainer:
                 self.metrics.log(epoch=epoch, avg_loss=em.avg_loss, train_accuracy=train_acc)
             )
             if ckpt is not None and epoch % max(cfg.save_every, 1) == 0:
-                ckpt.save(epoch, params, opt_state, meta={"epoch": epoch})
+                # async: the write overlaps the next epoch's compute; the
+                # manager's internal barrier (or close()) commits it
+                ckpt.save(epoch, params, opt_state, meta={"epoch": epoch}, wait=False)
         last_epoch = cfg.epochs
         if ckpt is not None:
             # final state must always be persisted, even when epochs isn't a

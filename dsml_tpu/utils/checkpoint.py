@@ -35,7 +35,20 @@ class Checkpointer:
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
         )
 
-    def save(self, step: int, params: Any, opt_state: Any = None, meta: dict | None = None) -> None:
+    def save(
+        self,
+        step: int,
+        params: Any,
+        opt_state: Any = None,
+        meta: dict | None = None,
+        wait: bool = True,
+    ) -> None:
+        """Persist training state. ``wait=False`` makes the save ASYNC: Orbax
+        snapshots the device arrays and writes in a background thread while
+        training continues — the step loop never stalls on disk (call
+        :meth:`wait_until_finished` before shutdown, or let the next save's
+        internal barrier absorb it). The snapshot happens before return, so
+        later in-place param updates (donated buffers) can't corrupt it."""
         state = {"params": params}
         if opt_state is not None:
             state["opt_state"] = opt_state
@@ -44,8 +57,14 @@ class Checkpointer:
         # PyTreeSave (not StandardSave): the manager binds ONE handler per
         # item name, and only the PyTree handler supports partial restore
         self.manager.save(step, args=self._ocp.args.PyTreeSave(state))
+        if wait:
+            self.manager.wait_until_finished()
+        log.info("saved checkpoint step %d -> %s%s", step, self.directory,
+                 "" if wait else " (async)")
+
+    def wait_until_finished(self) -> None:
+        """Block until any in-flight async save has committed."""
         self.manager.wait_until_finished()
-        log.info("saved checkpoint step %d -> %s", step, self.directory)
 
     def latest_step(self) -> int | None:
         return self.manager.latest_step()

@@ -36,7 +36,8 @@ from dsml_tpu.utils.config import Config, field
 class GPT2TrainConfig(Config):
     platform: str = field("", help="jax platform override: cpu|tpu ('' = default)")
     cpu_devices: int = field(0, help="virtual CPU device count for --platform cpu")
-    model: str = field("tiny", help="tiny | small (125M, the BASELINE config) | medium | large | xl")
+    model: str = field("tiny", help="gpt2 family: tiny | small (125M, the BASELINE config) | medium | large | xl; llama family: tiny | tinyllama_1b | llama2_7b | llama3_8b")
+    family: str = field("gpt2", help="model family: gpt2 | llama (RMSNorm/RoPE/SwiGLU/GQA)")
     dtype: str = field("", help="params/activations dtype: float32 | bfloat16 ('' = model default; bfloat16 feeds the MXU at full rate on TPU)")
     remat: bool = field(False, help="rematerialize each block's activations in backward (less HBM, more FLOPs)")
     data: str = field("", help="UTF-8 text file to train on ('' = generated stories)")
@@ -124,14 +125,22 @@ def main(argv=None):
             )
 
     try:
-        model_cfg = GPT2Config.by_name(cfg.model, vocab_size=256)  # tiny = byte tokens
+        if cfg.family == "llama":
+            from dsml_tpu.models.llama import Llama, LlamaConfig
+
+            # by_name forwards the kwargs only for the tiny preset
+            model_cfg = LlamaConfig.by_name(cfg.model, vocab_size=256)
+        elif cfg.family == "gpt2":
+            model_cfg = GPT2Config.by_name(cfg.model, vocab_size=256)  # tiny = byte tokens
+        else:
+            raise ValueError(f"unknown family {cfg.family!r}; choose gpt2 | llama")
     except ValueError as e:
         raise SystemExit(str(e))
     if cfg.dtype:
         model_cfg = dataclasses.replace(model_cfg, dtype=cfg.dtype)
     if cfg.remat:
         model_cfg = dataclasses.replace(model_cfg, remat=True)
-    model = GPT2(model_cfg)
+    model = Llama(model_cfg) if cfg.family == "llama" else GPT2(model_cfg)
     seq = cfg.seq_len or model_cfg.max_seq
 
     # ---- tokens: file or generated corpus, byte-level --------------------------
@@ -191,8 +200,9 @@ def main(argv=None):
         log.info("resumed from checkpoint at step %d", start_step)
     n_params = model.n_params(params)
     log.info(
-        "GPT-2 %s: %.1fM params, mesh pp=%d dp=%d sp=%d tp=%d, seq=%d, batch=%d x accum=%d",
-        cfg.model, n_params / 1e6, cfg.pp, dp, cfg.sp, cfg.tp, seq, cfg.batch_size, cfg.grad_accum,
+        "%s %s: %.1fM params, mesh pp=%d dp=%d sp=%d tp=%d, seq=%d, batch=%d x accum=%d",
+        "Llama" if cfg.family == "llama" else "GPT-2", cfg.model, n_params / 1e6,
+        cfg.pp, dp, cfg.sp, cfg.tp, seq, cfg.batch_size, cfg.grad_accum,
     )
 
     import contextlib

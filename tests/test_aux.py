@@ -195,3 +195,29 @@ def test_checkpoint_partial_restore_params_only(tmp_path):
     np.testing.assert_array_equal(np.asarray(got["params"]["w"]), np.arange(8.0))
     assert "opt_state" not in got
     ckpt.close()
+
+
+def test_checkpoint_async_save_commits(tmp_path):
+    """wait=False returns before the write commits; wait_until_finished (or
+    the next sync save / close) makes it durable, and the snapshot taken at
+    save time is immune to later in-place mutation of the source arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    from dsml_tpu.utils.checkpoint import Checkpointer
+
+    params = {"w": jnp.arange(1024, dtype=jnp.float32)}
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    ckpt.save(1, params, wait=False)
+    # overwrite the SAVED BUFFERS while the write may still be in flight —
+    # the donated jit invalidates the source arrays, the hazard the trainer's
+    # epoch loop creates every step (donate_argnums on params/opt_state)
+    params = jax.jit(
+        lambda t: jax.tree.map(lambda a: a * 0.0, t), donate_argnums=0
+    )(params)
+    ckpt.wait_until_finished()
+    restored = ckpt.restore(1)
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.arange(1024, dtype=np.float32)
+    )
+    ckpt.close()
