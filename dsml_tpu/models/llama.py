@@ -229,9 +229,11 @@ class Llama(GPT2):
         return params["wte"][tokens]
 
     def _qkv_gqa(self, layer, x, n_head_local, n_kv_local, positions):
-        """Separate q/k/v projections, head split, RoPE on q/k, kv-head
-        repeat to the query head count (GQA → the shared attention impls see
-        MHA shapes)."""
+        """Separate q/k/v projections, head split, RoPE on q/k. Returns
+        ``(q, k_kv, v_kv, k_attn, v_attn)``: the kv-head forms (what the
+        serving cache stores) and the query-head-repeated forms (what the
+        shared MHA attention impls consume) — ONE copy of the GQA math for
+        both the training and serving paths."""
         hd = self.config.d_model // self.config.n_head
 
         def heads(t, n):
@@ -244,10 +246,9 @@ class Llama(GPT2):
         q = _rope(q, positions, self.config.rope_theta)
         k = _rope(k, positions, self.config.rope_theta)
         repeat = n_head_local // n_kv_local
-        if repeat > 1:
-            k = jnp.repeat(k, repeat, axis=1)
-            v = jnp.repeat(v, repeat, axis=1)
-        return q, k, v
+        ka = jnp.repeat(k, repeat, axis=1) if repeat > 1 else k
+        va = jnp.repeat(v, repeat, axis=1) if repeat > 1 else v
+        return q, k, v, ka, va
 
     def _block(self, layer, h, n_head_local, tp_axis, sp_axis, attn_impl):
         cfg = self.config
@@ -258,8 +259,8 @@ class Llama(GPT2):
         positions = offset + jnp.arange(s_local, dtype=jnp.int32)
 
         x = _rms_norm(h, layer["rms_1"]["scale"], cfg.rms_eps)
-        q, k, v = self._qkv_gqa(layer, x, n_head_local, n_kv_local, positions)
-        out = self._route_attention(q, k, v, sp_axis, attn_impl)
+        q, _, _, ka, va = self._qkv_gqa(layer, x, n_head_local, n_kv_local, positions)
+        out = self._route_attention(q, ka, va, sp_axis, attn_impl)
         out = self._merge_heads(out) @ layer["attn"]["wo"]
         if tp_axis:
             out = lax.psum(out, tp_axis)
@@ -320,35 +321,25 @@ class Llama(GPT2):
         return 0.0
 
     def _serving_qkv(self, layer, x, positions, tp_size):
-        """RoPE'd q/k/v: cache forms keep the kv heads (GQA), attention
-        forms repeat them to the query head count."""
+        """Thin wrapper over :meth:`_qkv_gqa` (one copy of the GQA math):
+        cache forms keep the kv heads, attention forms repeat them."""
         cfg = self.config
-        n_head_local = cfg.n_head // tp_size
-        n_kv_local = cfg.n_kv_head // tp_size
-        hd = cfg.d_model // cfg.n_head
-
-        def heads(t, n):
-            b, s, _ = t.shape
-            return t.reshape(b, s, n, hd).transpose(0, 2, 1, 3)
-
-        q = _rope(heads(x @ layer["attn"]["wq"], n_head_local), positions, cfg.rope_theta)
-        k = _rope(heads(x @ layer["attn"]["wk"], n_kv_local), positions, cfg.rope_theta)
-        v = heads(x @ layer["attn"]["wv"], n_kv_local)
-        repeat = n_head_local // n_kv_local
-        ka = jnp.repeat(k, repeat, axis=1) if repeat > 1 else k
-        va = jnp.repeat(v, repeat, axis=1) if repeat > 1 else v
-        return q, k, v, ka, va
+        return self._qkv_gqa(
+            layer, x, cfg.n_head // tp_size, cfg.n_kv_head // tp_size, positions
+        )
 
     def _decode_attention(self, q, ck, cv, valid):
         """Grouped-query attention against the kv-head cache — query heads
-        grouped over their kv head, no materialized repeat."""
+        grouped over their kv head, no materialized repeat; scores
+        accumulate f32 via preferred_element_type (no full-cache upcast
+        copies on the decode hot path)."""
         b, hq, s, hd = q.shape
         repeat = hq // ck.shape[1]
         qg = q.reshape(b, hq // repeat, repeat, s, hd)
         scores = jnp.einsum(
-            "bgrqd,bgkd->bgrqk", qg.astype(jnp.float32), ck.astype(jnp.float32)
+            "bgrqd,bgkd->bgrqk", qg, ck, preferred_element_type=jnp.float32
         ) * (hd ** -0.5)
         scores = jnp.where(valid[None, None, None, None, :], scores, _NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bgrqk,bgkd->bgrqd", probs, cv.astype(jnp.float32))
+        probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+        out = jnp.einsum("bgrqk,bgkd->bgrqd", probs, cv)
         return out.reshape(b, hq, s, hd).astype(q.dtype)

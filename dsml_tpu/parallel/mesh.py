@@ -80,3 +80,55 @@ def data_mesh(n: int | None = None, devices=None) -> Mesh:
 
     devices = list(devices if devices is not None else jax.devices())[: n or None]
     return build_mesh(MeshSpec(dp=len(devices)), devices)
+
+
+def multislice_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:
+    """Multi-slice mesh: ``dp`` (and only dp) spans the DCN between slices,
+    every other axis stays inside a slice's ICI — the LoongTrain fast/slow
+    split (SURVEY.md §5.7) at pod scale, and the layout
+    ``hierarchical_all_reduce('ici_axes', 'dp')`` assumes.
+
+    Devices are grouped by their ``slice_index`` attribute (real multi-slice
+    TPU runtimes expose it; hosts without one — CPU meshes, single slices —
+    fall back to one virtual slice, making this a drop-in ``build_mesh``).
+    Requirements: equal devices per slice; spec.dp must equal
+    ``n_slices × per-slice dp remainder`` — i.e. the non-dp axes must fit
+    inside ONE slice, which is exactly the property that keeps tp/sp/fsdp
+    collectives off the DCN.
+    """
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    spec = (spec or MeshSpec()).resolved(len(devices))
+    return Mesh(_multislice_layout(devices, spec), AXES)
+
+
+def _multislice_layout(devices, spec: MeshSpec) -> np.ndarray:
+    """The device array for :func:`multislice_mesh` (separable for tests:
+    works on any objects carrying ``slice_index``)."""
+    slices: dict = {}
+    for d in devices:
+        slices.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    n_slices = len(slices)
+    per_slice = [len(v) for v in slices.values()]
+    if len(set(per_slice)) != 1:
+        raise ValueError(f"unequal slice sizes {per_slice}; a mesh needs a rectangle")
+    inner = spec.pp * spec.fsdp * spec.sp * spec.tp
+    if spec.dp % n_slices:
+        raise ValueError(f"dp={spec.dp} not divisible by n_slices={n_slices}")
+    if inner * (spec.dp // n_slices) != per_slice[0]:
+        raise ValueError(
+            f"non-dp axes (pp*fsdp*sp*tp={inner}) x per-slice dp "
+            f"({spec.dp // n_slices}) must fill one slice ({per_slice[0]} devices); "
+            "shrink tp/sp/pp so they fit inside a slice — crossing the DCN with "
+            "them defeats the point of the multislice layout"
+        )
+    # device order: slice-major on the dp axis → dp index = slice * dp_per + i,
+    # so every non-dp axis (and the intra-slice part of dp) stays on ICI and
+    # only the outer dp hops ride the DCN
+    ordered = [d for k in sorted(slices) for d in slices[k]]
+    shape = tuple(getattr(spec, a) for a in AXES)
+    arr = np.empty(len(ordered), dtype=object)
+    arr[:] = ordered
+    arr = arr.reshape(n_slices, spec.dp // n_slices, spec.pp, spec.fsdp, spec.sp, spec.tp)
+    return arr.transpose(2, 0, 1, 3, 4, 5).reshape(shape)

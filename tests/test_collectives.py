@@ -166,3 +166,59 @@ def test_auto_matches_exact_both_regimes(mesh8):
         fn = lambda x: C.all_reduce(x[0], "dev", C.ReduceOp.SUM, "auto")[None]
         out = _run_collective(mesh8, fn, xs)
         np.testing.assert_allclose(out[0], xs.sum(axis=0), rtol=1e-4)
+
+
+class _Dev:
+    """Device stub carrying slice_index for layout tests."""
+
+    def __init__(self, i, s):
+        self.id, self.slice_index = i, s
+
+    def __repr__(self):
+        return f"d{self.id}@s{self.slice_index}"
+
+
+def test_multislice_layout_dp_spans_slices():
+    """2 slices × 4 chips, spec tp=2, dp=4: tp pairs stay inside a slice;
+    the dp axis is slice-major so only its outer hops cross the DCN."""
+    from dsml_tpu.parallel.mesh import MeshSpec, _multislice_layout
+
+    devs = [_Dev(i, i // 4) for i in range(8)]
+    arr = _multislice_layout(devs, MeshSpec(dp=4, tp=2).resolved(8))
+    assert arr.shape == (1, 4, 1, 1, 2)
+    # every tp pair within one slice
+    for dp_i in range(4):
+        pair = arr[0, dp_i, 0, 0, :]
+        assert pair[0].slice_index == pair[1].slice_index, arr
+    # dp index 0,1 → slice 0; dp index 2,3 → slice 1 (slice-major)
+    assert [arr[0, i, 0, 0, 0].slice_index for i in range(4)] == [0, 0, 1, 1]
+
+
+def test_multislice_layout_rejects_tp_across_dcn():
+    from dsml_tpu.parallel.mesh import MeshSpec, _multislice_layout
+
+    devs = [_Dev(i, i // 4) for i in range(8)]
+    with pytest.raises(ValueError, match="not divisible by n_slices"):
+        # dp=1 can't span 2 slices (tp=8 would cross the DCN)
+        _multislice_layout(devs, MeshSpec(dp=1, tp=8).resolved(8))
+    with pytest.raises(ValueError, match="fill one slice"):
+        # unresolved 4-device spec over 8 devices: inner*dp_per != per_slice
+        _multislice_layout(devs, MeshSpec(dp=2, tp=2))
+
+
+def test_multislice_mesh_single_slice_trains(devices8):
+    """Hosts without slice_index = one virtual slice: multislice_mesh is a
+    drop-in build_mesh, and a psum over its dp axis is correct."""
+    from dsml_tpu.parallel.mesh import MeshSpec, multislice_mesh
+
+    mesh = multislice_mesh(MeshSpec(dp=4, tp=2), devices8)
+    assert dict(mesh.shape) == {"pp": 1, "dp": 4, "fsdp": 1, "sp": 1, "tp": 1} | {"tp": 2}
+    xs = np.arange(8, dtype=np.float32).reshape(4, 2)
+
+    out = jax.jit(
+        jax.shard_map(
+            lambda x: jax.lax.psum(x, "dp"),
+            mesh=mesh, in_specs=P("dp", "tp"), out_specs=P(None, "tp"), check_vma=False,
+        )
+    )(xs)
+    np.testing.assert_allclose(np.asarray(out)[0], xs.sum(0))
