@@ -96,6 +96,22 @@ def bench_gpt2() -> dict:
         )
     except Exception as e:
         out["gpt2_seq8k_error"] = repr(e)[:200]
+    # scale row: GPT-2-medium (350M) — MFU climbs with model size (less of
+    # the step is the small-matmul/vocab tail), the don't-stop-at-parity
+    # evidence beyond the BASELINE flagship
+    try:
+        med = _gpt2_train_throughput(batch=4, seq=1024, xent_chunk=0, k_extra=3,
+                                     reps=6, preset="medium")
+        out.update(
+            {
+                "gpt2_medium_tokens_per_sec": med["tokens_per_sec"],
+                "gpt2_medium_mfu": med["mfu"],
+                "gpt2_medium_step_ms": med["step_ms"],
+                "gpt2_medium_params": med["params"],
+            }
+        )
+    except Exception as e:
+        out["gpt2_medium_error"] = repr(e)[:200]
     # serving row: greedy KV-cache decode throughput (the reference has no
     # inference path at all)
     try:
@@ -147,7 +163,8 @@ def bench_gpt2_decode() -> dict:
 
 
 def _gpt2_train_throughput(
-    batch: int, seq: int, xent_chunk: int, k_extra: int = 4, reps: int = 10
+    batch: int, seq: int, xent_chunk: int, k_extra: int = 4, reps: int = 10,
+    preset: str = "small",
 ) -> dict:
     import jax
     import jax.numpy as jnp
@@ -162,7 +179,7 @@ def _gpt2_train_throughput(
     # length; dense logits beat the chunked stream when they fit; donating
     # params+opt_state buys ~20% by letting XLA update in place.
     cfg = dataclasses.replace(
-        GPT2Config.small(), dtype="bfloat16", max_seq=seq, xent_chunk=xent_chunk
+        GPT2Config.by_name(preset), dtype="bfloat16", max_seq=seq, xent_chunk=xent_chunk
     )
     model = GPT2(cfg)
     dev = jax.devices()[0]

@@ -852,6 +852,7 @@ class GPT2:
         top_k: int = 0,
         top_p: float = 0.0,
         seed: int = 0,
+        dp_shard: bool = False,
     ) -> jax.Array:
         """TP-sharded serving: :meth:`generate` with Megatron-sharded params
         over the mesh's ``tp`` axis (``shard_params(model.param_specs())``
@@ -860,7 +861,17 @@ class GPT2:
         all_gather) and runs the identical sampler with the identical key,
         so the tokens match the single-device path exactly (tests pin it).
         The reference has no inference at all — this is the serving shape a
-        125M+ flagship needs."""
+        125M+ flagship needs.
+
+        ``dp_shard=True`` additionally shards the BATCH over the mesh's
+        ``dp`` axis — throughput serving: each dp group decodes its own
+        prompt rows, tp still shards heads within the group. Sampler keys
+        fold in the GLOBAL row index, so results are independent of how the
+        batch is split (dp=N equals dp=1, both with ``dp_shard=True``);
+        greedy decoding additionally equals :meth:`generate`. Sampled runs
+        use a different key-per-row derivation than the shared-key unsharded
+        paths, so they are row-decomposable rather than bit-identical to
+        ``dp_shard=False``."""
         b, t = prompt.shape
         self._check_generate_args(t, max_new_tokens, temperature, top_k, top_p)
         tp_size = mesh.shape.get("tp", 1)
@@ -868,19 +879,24 @@ class GPT2:
             raise ValueError(f"n_head={self.config.n_head} not divisible by tp={tp_size}")
         from jax.sharding import PartitionSpec as P
 
-        key_ = ("spmd", mesh, t, max_new_tokens, float(temperature), int(top_k), float(top_p))
+        dp_size = mesh.shape.get("dp", 1) if dp_shard else 1
+        if dp_shard and b % dp_size:
+            raise ValueError(f"batch {b} not divisible by dp={dp_size} for dp_shard")
+        batch_spec = P("dp") if dp_shard else P()
+        key_ = ("spmd", mesh, t, max_new_tokens, float(temperature), int(top_k),
+                float(top_p), dp_shard)
         cache = self._gen_cache_dict()
         run = cache.get(key_)
         if run is None:
             raw = self._generate_fn(
                 t, max_new_tokens, float(temperature), int(top_k), float(top_p),
-                tp_axis="tp", jit=False,
+                tp_axis="tp", jit=False, dp_axis="dp" if dp_shard else None,
             )
             run = jax.jit(
                 jax.shard_map(
                     raw, mesh=mesh,
-                    in_specs=(self.param_specs(), P(), P()),
-                    out_specs=P(), check_vma=False,
+                    in_specs=(self.param_specs(), batch_spec, P()),
+                    out_specs=batch_spec, check_vma=False,
                 )
             )
             cache[key_] = run
@@ -895,10 +911,16 @@ class GPT2:
     def _generate_fn(
         self, prompt_len: int, max_new_tokens: int, temperature: float, top_k: int,
         top_p: float = 0.0, tp_axis: str | None = None, jit: bool = True,
+        dp_axis: str | None = None,
     ):
         """Compiled generate program, cached per (prompt_len, max_new,
-        temperature, top_k, top_p) so repeated serving calls don't re-trace."""
-        key_ = (prompt_len, max_new_tokens, temperature, top_k, top_p, tp_axis, jit)
+        temperature, top_k, top_p) so repeated serving calls don't re-trace.
+        ``dp_axis`` (dp-sharded serving) folds each GLOBAL batch row's index
+        (this rank's shard offset from that axis) into its sampler key, so a
+        dp-sharded run samples per row independently of how the batch is
+        split across ranks."""
+        key_ = (prompt_len, max_new_tokens, temperature, top_k, top_p, tp_axis, jit,
+                dp_axis)
         cache = self._gen_cache_dict()
         if key_ in cache:
             return cache[key_]
@@ -924,16 +946,24 @@ class GPT2:
                 logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
             return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
+        def sample_rows(logits, key):
+            if dp_axis is None:
+                return sample(logits, key)
+            b = logits.shape[0]
+            row_ids = lax.axis_index(dp_axis) * b + jnp.arange(b)
+            keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(row_ids)
+            return jax.vmap(lambda lg, kk: sample(lg[None], kk)[0])(logits, keys)
+
         def run(params, prompt, key):
             logits, kv = self.prefill(params, prompt, tp_axis)
             key, sub = jax.random.split(key)
-            first = sample(logits, sub)
+            first = sample_rows(logits, sub)
 
             def body(carry, _):
                 kv, tok, pos, key = carry
                 logits, kv = self.decode_step(params, kv, tok, pos, tp_axis)
                 key, sub = jax.random.split(key)
-                nxt = sample(logits, sub)
+                nxt = sample_rows(logits, sub)
                 return (kv, nxt, pos + 1, key), nxt
 
             carry = (kv, first, jnp.asarray(prompt_len, jnp.int32), key)

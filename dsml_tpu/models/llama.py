@@ -341,5 +341,8 @@ class Llama(GPT2):
         ) * (hd ** -0.5)
         scores = jnp.where(valid[None, None, None, None, :], scores, _NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
-        out = jnp.einsum("bgrqk,bgkd->bgrqd", probs, cv)
+        # bf16 inputs feed the MXU at full rate; f32 accumulation keeps the
+        # long-context value sum from drifting (same precision as the scores)
+        out = jnp.einsum("bgrqk,bgkd->bgrqd", probs, cv,
+                         preferred_element_type=jnp.float32)
         return out.reshape(b, hq, s, hd).astype(q.dtype)

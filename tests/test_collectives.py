@@ -212,7 +212,7 @@ def test_multislice_mesh_single_slice_trains(devices8):
     from dsml_tpu.parallel.mesh import MeshSpec, multislice_mesh
 
     mesh = multislice_mesh(MeshSpec(dp=4, tp=2), devices8)
-    assert dict(mesh.shape) == {"pp": 1, "dp": 4, "fsdp": 1, "sp": 1, "tp": 1} | {"tp": 2}
+    assert dict(mesh.shape) == {"pp": 1, "dp": 4, "fsdp": 1, "sp": 1, "tp": 2}
     xs = np.arange(8, dtype=np.float32).reshape(4, 2)
 
     out = jax.jit(
