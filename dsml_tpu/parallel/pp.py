@@ -12,6 +12,17 @@ pipelined backward (synchronous GPipe semantics: bubble fraction
 
 Params arrive layer-stacked (leading layer axis) and sharded ``P('pp', ...)``
 so shard_map hands each rank exactly its stage's layers.
+
+On the rest of the reference's PP literature folder: Zero-Bubble's B/W
+backward split and Chimera's bidirectional pipelines both win by filling a
+rank's IDLE tick slots with other work — but under SPMD lockstep every rank
+executes every tick's program anyway (inactive ranks compute-and-discard,
+see the ``where`` note in :func:`pipeline_apply`), so there are no idle
+slots to fill: ZB would re-run the same ticks with extra bookkeeping, and
+Chimera's two directions would double per-tick work for the same makespan.
+The schedule that DOES help here is Megatron's interleave
+(:func:`pipeline_apply_interleaved`): it shrinks the number of wasted
+ticks, not how they're filled.
 """
 
 from __future__ import annotations

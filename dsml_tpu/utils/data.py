@@ -199,3 +199,47 @@ def shard_batches(
     for start in range(0, end, batch_size):
         sel = idx[start : start + batch_size]
         yield x[sel], y[sel]
+
+
+def lm_window_batches(
+    tokens: np.ndarray,
+    seq_len: int,
+    batch_size: int,
+    seed: int = 0,
+    steps: int | None = None,
+):
+    """Yield (x, y) next-token LM batches: ``batch_size`` random windows of
+    ``seq_len`` tokens each, y = x shifted one token left. The language-model
+    counterpart of :func:`shard_batches` (same contract: GLOBAL batch, the
+    mesh's ``P('dp')`` placement shards it); composes with
+    :func:`prefetch_batches` so window assembly overlaps device compute.
+    ``steps=None`` streams forever (training loops bound their own step
+    count)."""
+    tokens = np.asarray(tokens)
+    if len(tokens) < seq_len + 1:
+        raise ValueError(f"corpus of {len(tokens)} tokens too small for seq_len={seq_len}")
+    rng = np.random.default_rng(seed)
+    produced = 0
+    while steps is None or produced < steps:
+        # a start s is valid iff s + seq_len + 1 <= len (y reaches one past
+        # x), so the exclusive high is len - seq_len — the last token of the
+        # corpus IS reachable as a target
+        starts = rng.integers(0, len(tokens) - seq_len, size=batch_size)
+        x = np.stack([tokens[s : s + seq_len] for s in starts])
+        y = np.stack([tokens[s + 1 : s + seq_len + 1] for s in starts])
+        yield x.astype(np.int32), y.astype(np.int32)
+        produced += 1
+
+
+def carve_lm_eval_split(
+    tokens: np.ndarray, seq_len: int, batch_size: int, frac: float = 0.05
+) -> tuple[np.ndarray, np.ndarray | None]:
+    """Split a token stream into (train, eval) tails for held-out perplexity.
+    Returns ``(tokens, None)`` — eval disabled — when the corpus is too small
+    to carve ``frac`` (or one batch of windows) without starving training."""
+    tokens = np.asarray(tokens)
+    carve = max((seq_len + 1) * batch_size, int(len(tokens) * frac), seq_len + 2)
+    if carve > len(tokens) // 4 or len(tokens) - carve <= seq_len + 1:
+        return tokens, None
+    split = len(tokens) - carve
+    return tokens[:split], tokens[split:]

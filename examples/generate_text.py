@@ -28,7 +28,8 @@ from dsml_tpu.utils.config import Config, field
 class GenerateConfig(Config):
     platform: str = field("", help="jax platform override: cpu|tpu ('' = default)")
     cpu_devices: int = field(0, help="virtual CPU device count for --platform cpu")
-    model: str = field("tiny", help="tiny | small | medium | large | xl — must match the trained model")
+    model: str = field("tiny", help="preset — must match the trained model (gpt2: tiny|small|medium|large|xl; llama: tiny|tinyllama_1b|llama2_7b|llama3_8b)")
+    family: str = field("gpt2", help="model family: gpt2 | llama")
     checkpoint_dir: str = field("", help="Orbax dir from train_gpt2 ('' = fresh weights)")
     prompt: str = field("the cat ", help="prompt text (byte-tokenized)")
     n_samples: int = field(2, help="continuations to sample")
@@ -53,10 +54,18 @@ def main(argv=None):
 
     log = get_logger("generate")
     try:
-        model_cfg = GPT2Config.by_name(cfg.model, vocab_size=256)  # tiny = byte tokens
+        if cfg.family == "llama":
+            from dsml_tpu.models.llama import Llama, LlamaConfig
+
+            model_cfg = LlamaConfig.by_name(cfg.model, vocab_size=256)
+            model = Llama(model_cfg)
+        elif cfg.family == "gpt2":
+            model_cfg = GPT2Config.by_name(cfg.model, vocab_size=256)  # tiny = byte tokens
+            model = GPT2(model_cfg)
+        else:
+            raise ValueError(f"unknown family {cfg.family!r}; choose gpt2 | llama")
     except ValueError as e:
         raise SystemExit(str(e))
-    model = GPT2(model_cfg)
     params = model.init(0)
     if cfg.checkpoint_dir:
         from dsml_tpu.utils.checkpoint import Checkpointer

@@ -1,0 +1,104 @@
+"""Elastic training demo: lose devices mid-run, re-plan, keep training.
+
+The reference's failure story ends at "communicator FAILED" (SURVEY.md §5.3:
+recovery none); this example shows the framework's whole elastic loop on a
+virtual CPU fleet: train the tiny GPT-2 on N devices, simulate losing some
+at ``--fail_at_step`` (the mesh-shrinks-between-steps model a multi-host
+drop presents), audit recoverability, re-plan the parallelism for the
+survivors with the capacity-rule auto-planner, re-shard params + optimizer
+statistics in place, and continue — loss trajectory unbroken.
+
+    python examples/train_elastic.py --devices 8 --lose 3 --fail_at_step 5
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")  # repo-root invocation
+
+from dsml_tpu.utils.config import Config, field
+
+
+@dataclasses.dataclass
+class ElasticDemoConfig(Config):
+    devices: int = field(8, help="virtual CPU devices to start with")
+    lose: int = field(3, help="devices to lose at the failure point")
+    fail_at_step: int = field(5, help="step after which the failure hits")
+    steps: int = field(10, help="total optimizer steps")
+    batch_size: int = field(8, help="global batch size")
+    lr: float = field(1e-2, help="adam learning rate")
+    seed: int = field(0, help="init/data seed")
+
+
+def main(argv=None):
+    cfg = ElasticDemoConfig.parse_args(argv)
+    from dsml_tpu.utils.platform import configure_platform
+
+    configure_platform("cpu", cfg.devices)
+
+    import jax
+    import optax
+
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.parallel.elastic import reconfigure
+    from dsml_tpu.parallel.hybrid import init_hybrid, make_hybrid_train_step
+    from dsml_tpu.parallel.mesh import data_mesh
+    from dsml_tpu.utils.data import lm_window_batches
+    from dsml_tpu.utils.logging import get_logger
+
+    log = get_logger("elastic")
+    if not 0 < cfg.lose < cfg.devices:
+        raise SystemExit(f"--lose must be in (0, {cfg.devices})")
+    if cfg.steps < 1:
+        raise SystemExit("--steps must be >= 1")
+    if not 0 < cfg.fail_at_step < cfg.steps:
+        raise SystemExit(
+            f"--fail_at_step must be in (0, {cfg.steps}) so the run actually "
+            "crosses the failure (that's the demo)"
+        )
+    devices = jax.devices()[: cfg.devices]
+
+    model_cfg = GPT2Config.tiny(vocab_size=256)
+    model = GPT2(model_cfg)
+    optimizer = optax.adam(cfg.lr)
+    mesh = data_mesh(devices=devices)  # pure DP: every leaf replicated → recoverable
+    step = make_hybrid_train_step(model, optimizer, mesh, attn_impl="ring")
+    params, opt_state = init_hybrid(model, optimizer, mesh, seed=cfg.seed)
+    log.info("training on %d devices, mesh %s", cfg.devices, dict(mesh.shape))
+
+    rng_corpus = np.random.default_rng(cfg.seed)
+    corpus = rng_corpus.integers(0, 256, size=1 << 18).astype(np.int32)
+    batches = lm_window_batches(corpus, model_cfg.max_seq, cfg.batch_size, seed=cfg.seed)
+
+    t0 = time.monotonic()
+    for i in range(1, cfg.steps + 1):
+        x, y = next(batches)
+        params, opt_state, loss = step(params, opt_state, x, y)
+        log.info("step %d: loss = %.4f", i, float(loss))
+
+        if i == cfg.fail_at_step:
+            survivors = devices[: cfg.devices - cfg.lose]
+            lost = devices[cfg.devices - cfg.lose :]
+            log.warning("losing %d device(s) %s", cfg.lose, [d.id for d in lost])
+            state = reconfigure(
+                model, optimizer, params, opt_state,
+                surviving_devices=survivors, lost_devices=lost,
+                global_batch=cfg.batch_size,
+            )
+            for reason in state.reasons:
+                log.info("plan: %s", reason)
+            params, opt_state = state.params, state.opt_state
+            step = make_hybrid_train_step(model, optimizer, state.mesh, attn_impl="ring")
+            log.info("continuing on %d devices, mesh %s",
+                     len(state.mesh.devices.flat), dict(state.mesh.shape))
+    log.info("done: %d steps across the failure in %.1fs", cfg.steps, time.monotonic() - t0)
+    return float(loss)
+
+
+if __name__ == "__main__":
+    main()

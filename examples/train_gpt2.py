@@ -152,28 +152,17 @@ def main(argv=None):
         need = cfg.steps * cfg.batch_size * (seq + 1) * 2
         corpus = _generated_stories(max(need, 1 << 20), cfg.seed)
         log.info("no --data file; generated %d bytes of story corpus", len(corpus))
+    from dsml_tpu.utils.data import carve_lm_eval_split, lm_window_batches, prefetch_batches
+
     tokens = np.frombuffer(corpus, np.uint8).astype(np.int32) % model_cfg.vocab_size
     eval_tokens = None
     if cfg.eval_every:
-        carve = max((seq + 1) * cfg.batch_size, len(tokens) // 20, seq + 2)
-        if carve > len(tokens) // 4 or len(tokens) - carve <= seq + 1:
+        tokens, eval_tokens = carve_lm_eval_split(tokens, seq, cfg.batch_size)
+        if eval_tokens is None:
             log.warning(
-                "corpus (%d tokens) too small to carve a %d-token eval split at "
-                "seq=%d; eval disabled, training keeps the full corpus",
-                len(tokens), carve, seq,
+                "corpus (%d tokens) too small to carve an eval split at seq=%d; "
+                "eval disabled, training keeps the full corpus", len(tokens), seq,
             )
-        else:
-            split = len(tokens) - carve
-            tokens, eval_tokens = tokens[:split], tokens[split:]
-
-    def sample_from(pool, rng):
-        starts = rng.integers(0, len(pool) - seq - 1, size=cfg.batch_size)
-        x = np.stack([pool[s : s + seq] for s in starts])
-        y = np.stack([pool[s + 1 : s + seq + 1] for s in starts])
-        return x, y
-
-    def sample_batch(rng):
-        return sample_from(tokens, rng)
 
     # probe the checkpoint FIRST: a resumed optimizer count sits at
     # start_step, so the cosine horizon must cover start_step + cfg.steps or
@@ -227,18 +216,21 @@ def main(argv=None):
             )
         )
         # one fixed held-out batch, built once (it's deterministic anyway)
-        eval_x, eval_y = sample_from(eval_tokens, np.random.default_rng(1234))
+        eval_x, eval_y = next(lm_window_batches(eval_tokens, seq, cfg.batch_size, seed=1234))
 
     # advance the data stream past what the first run consumed, like the
-    # Trainer's per-epoch cfg.seed + epoch
-    rng = np.random.default_rng(cfg.seed + start_step)
+    # Trainer's per-epoch cfg.seed + epoch; window assembly runs in a
+    # background thread so host prep overlaps device compute
+    batches = prefetch_batches(
+        lm_window_batches(tokens, seq, cfg.batch_size, seed=cfg.seed + start_step)
+    )
     t0 = time.monotonic()
     tokens_done = 0
     first_loss = None
     profiler = trace(cfg.profile_dir) if cfg.profile_dir else contextlib.nullcontext()
     with profiler:
         for i in range(1, cfg.steps + 1):
-            x, y = sample_batch(rng)
+            x, y = next(batches)
             params, opt_state, loss = step(params, opt_state, x, y)
             tokens_done += x.size
             if first_loss is None:

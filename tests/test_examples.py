@@ -49,3 +49,29 @@ def test_cifar_example_synthetic_fallback(tmp_path):
 
     data = train_cifar_resnet.load_cifar10(str(tmp_path / "missing"), synth_n=128, seed=0)
     assert data.train_x.shape[1:] == (32, 32, 3)
+
+
+def test_llama_family_example_trains():
+    import train_gpt2
+
+    result = train_gpt2.main(
+        [
+            "--family", "llama",
+            "--steps", "6",
+            "--batch_size", "4",
+            "--grad_accum", "2",
+            "--dp", "2", "--sp", "1", "--tp", "2",
+            "--log_every", "3",
+        ]
+    )
+    assert np.isfinite(result["last_loss"])
+    assert result["last_loss"] < result["first_loss"]
+
+
+def test_elastic_example_survives_device_loss():
+    import train_elastic
+
+    loss = train_elastic.main(
+        ["--devices", "8", "--lose", "3", "--fail_at_step", "2", "--steps", "4"]
+    )
+    assert np.isfinite(loss)
