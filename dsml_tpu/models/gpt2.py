@@ -739,6 +739,11 @@ class GPT2:
     def _attn_out_bias(self, layer):
         return layer["attn"]["bo"]
 
+    def _prefill_use_flash(self, t: int) -> bool:
+        """Gate for the flash-kernel prefill path — separable so tests can
+        force it on under the Pallas interpreter (CI has no TPU)."""
+        return jax.default_backend() == "tpu" and t >= 512
+
     def _serving_qkv(self, layer, x, positions, tp_size):
         """(q, k_cache, v_cache, k_attn, v_attn) for the serving path.
         ``positions`` [s] are the global token positions of ``x`` (ignored
@@ -765,10 +770,21 @@ class GPT2:
         positions = jnp.arange(t, dtype=jnp.int32)
         h = self._embed_spmd(params, tokens, tp_axis)
         cache = self.init_cache(b, tp_size)
+        # long prompts: the plain path materializes [T, T] scores per head —
+        # route through the flash kernel so prefill memory stays O(block²)
+        # (flash_attention itself falls back for untileable lengths)
+        use_flash = self._prefill_use_flash(t)
+        if use_flash:
+            from dsml_tpu.ops.flash import flash_attention
+
         for i, layer in enumerate(params["layers"]):
             x = self._norm1(layer, h)
             q, kc, vc, ka, va = self._serving_qkv(layer, x, positions, tp_size)
-            out = attention(q, ka, va, causal=True)
+            out = (
+                flash_attention(q, ka, va, causal=True)
+                if use_flash
+                else attention(q, ka, va, causal=True)
+            )
             attn_out = self._merge_heads(out) @ layer["attn"]["wo"]
             if tp_axis:
                 attn_out = lax.psum(attn_out, tp_axis)

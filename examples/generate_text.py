@@ -49,21 +49,13 @@ def main(argv=None):
 
     import jax.numpy as jnp
 
-    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
     from dsml_tpu.utils.logging import get_logger
 
     log = get_logger("generate")
-    try:
-        if cfg.family == "llama":
-            from dsml_tpu.models.llama import Llama, LlamaConfig
+    from dsml_tpu.models import model_by_family
 
-            model_cfg = LlamaConfig.by_name(cfg.model, vocab_size=256)
-            model = Llama(model_cfg)
-        elif cfg.family == "gpt2":
-            model_cfg = GPT2Config.by_name(cfg.model, vocab_size=256)  # tiny = byte tokens
-            model = GPT2(model_cfg)
-        else:
-            raise ValueError(f"unknown family {cfg.family!r}; choose gpt2 | llama")
+    try:
+        model, model_cfg = model_by_family(cfg.family, cfg.model, vocab_size=256)  # tiny = byte tokens
     except ValueError as e:
         raise SystemExit(str(e))
     params = model.init(0)

@@ -94,7 +94,6 @@ def main(argv=None):
     import jax
     import optax
 
-    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
     from dsml_tpu.parallel.hybrid import init_hybrid, make_hybrid_train_step
     from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
     from dsml_tpu.utils.logging import get_logger
@@ -124,23 +123,17 @@ def main(argv=None):
                 f"rows per dp rank; using n_micro={n_micro}"
             )
 
-    try:
-        if cfg.family == "llama":
-            from dsml_tpu.models.llama import Llama, LlamaConfig
+    from dsml_tpu.models import model_by_family
 
-            # by_name forwards the kwargs only for the tiny preset
-            model_cfg = LlamaConfig.by_name(cfg.model, vocab_size=256)
-        elif cfg.family == "gpt2":
-            model_cfg = GPT2Config.by_name(cfg.model, vocab_size=256)  # tiny = byte tokens
-        else:
-            raise ValueError(f"unknown family {cfg.family!r}; choose gpt2 | llama")
+    try:
+        model, model_cfg = model_by_family(cfg.family, cfg.model, vocab_size=256)  # tiny = byte tokens
     except ValueError as e:
         raise SystemExit(str(e))
     if cfg.dtype:
         model_cfg = dataclasses.replace(model_cfg, dtype=cfg.dtype)
     if cfg.remat:
         model_cfg = dataclasses.replace(model_cfg, remat=True)
-    model = Llama(model_cfg) if cfg.family == "llama" else GPT2(model_cfg)
+    model = type(model)(model_cfg)
     seq = cfg.seq_len or model_cfg.max_seq
 
     # ---- tokens: file or generated corpus, byte-level --------------------------

@@ -174,3 +174,37 @@ def test_awkward_survivor_count_idles_devices(devices8):
     step2 = make_hybrid_train_step(model, opt, state.mesh, attn_impl="ring")
     _, _, loss = step2(state.params, state.opt_state, x, y)
     assert np.isfinite(float(loss))
+
+
+def test_elastic_restack_for_new_pipeline(devices8, monkeypatch):
+    """Future-proofing pin: when the (here: forced) plan KEEPS a pipeline,
+    reconfigure must restack the layers for the new stage count — including
+    the interleave permutation — not reuse the old stacking."""
+    import optax as _optax
+
+    import dsml_tpu.parallel.elastic as E
+    from dsml_tpu.parallel.auto import AutoPlan
+
+    cfg = dataclasses.replace(GPT2Config.tiny(), n_layer=4, pp_interleave=2)
+    model = GPT2(cfg)
+    opt = _optax.adam(1e-3)
+    mesh8 = build_mesh(MeshSpec(pp=2, dp=2, sp=1, tp=2), devices8)
+    params, opt_state = init_hybrid(model, opt, mesh8, seed=0)
+    ref_stacked = np.asarray(jax.device_get(params["layers"]["attn"]["wqkv"]))
+    x, y = _data(cfg, n=4)
+
+    monkeypatch.setattr(
+        E, "plan_mesh",
+        lambda **kw: AutoPlan(spec=MeshSpec(pp=2, dp=1, sp=1, tp=2), reasons=("forced pp=2",)),
+    )
+    lost = [devices8[i] for i in (2, 3, 6, 7)]  # one dp replica: recoverable
+    surv = [devices8[i] for i in (0, 1, 4, 5)]
+    st = E.reconfigure(model, opt, params, opt_state, surviving_devices=surv, lost_devices=lost)
+    assert st.spec.pp == 2
+    # same S and v → the restacked order equals the original stacking
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(st.params["layers"]["attn"]["wqkv"])), ref_stacked
+    )
+    step2 = make_hybrid_train_step(model, opt, st.mesh, attn_impl="ring", n_microbatches=2)
+    _, _, loss = step2(st.params, st.opt_state, x, y)
+    assert np.isfinite(float(loss))

@@ -201,3 +201,24 @@ def test_generate_spmd_dp_sharded_matches_unsharded(devices8):
 
     with pytest.raises(ValueError, match="not divisible by dp"):
         model.generate_spmd(placed, prompt[:6], max_new_tokens=2, mesh=mesh, dp_shard=True)
+
+
+def test_prefill_flash_path_matches_plain(monkeypatch):
+    """The flash-kernel prefill branch (TPU-gated in production) under the
+    Pallas interpreter: last-position logits match the plain-attention
+    prefill — pins the branch CI can't otherwise reach."""
+    import dataclasses
+
+    cfg = dataclasses.replace(GPT2Config.tiny(), max_seq=512)
+    model = GPT2(cfg)
+    params = model.init(11)
+    prompt = jnp.asarray(
+        np.random.default_rng(12).integers(0, cfg.vocab_size, (1, 512)), jnp.int32
+    )
+    plain_logits, _ = model.prefill(params, prompt)
+    monkeypatch.setattr(GPT2, "_prefill_use_flash", lambda self, t: t >= 512)
+    flash_logits, cache = model.prefill(params, prompt)
+    np.testing.assert_allclose(
+        np.asarray(flash_logits), np.asarray(plain_logits), rtol=2e-4, atol=2e-4
+    )
+    assert cache[0]["k"].shape[2] == cfg.max_seq
