@@ -208,4 +208,16 @@ def init_hybrid(model, optimizer, mesh: Mesh, seed: int = 0):
         params = {**params, "layers": stack_layer_params(layers)}
     params = shard_params(params, mesh, model.param_specs(pp=pp))
     opt_state = jax.jit(optimizer.init)(params)
+
+    # leaves jit creates from scratch (adam's step count) come back on a
+    # single device with no mesh sharding; the live run tolerates the mix,
+    # but a checkpoint RESTORE of such a leaf comes back committed and then
+    # collides with the mesh-placed params inside the jitted step — pin
+    # every leaf to the mesh now so saved templates carry real shardings
+    def pin(leaf):
+        if isinstance(leaf, jax.Array) and not isinstance(leaf.sharding, NamedSharding):
+            return jax.device_put(leaf, NamedSharding(mesh, P()))
+        return leaf
+
+    opt_state = jax.tree.map(pin, opt_state)
     return params, opt_state

@@ -86,12 +86,27 @@ class Checkpointer:
             # checkpoint loaded into an f32 inference model, or a restore
             # onto a different mesh)
             restore_args = self._ocp.checkpoint_utils.construct_restore_args(template)
-            return self.manager.restore(
+            restored = self.manager.restore(
                 step,
                 args=self._ocp.args.PyTreeRestore(
                     item=ref, restore_args=restore_args, partial_restore=partial
                 ),
             )
+
+            # belt-and-braces: Orbax can hand scalar/replicated leaves back
+            # on a single device even when the template is mesh-placed —
+            # mixing them into a jitted step then fails with "incompatible
+            # devices". Re-place any leaf whose sharding drifted.
+            def place(t, r):
+                if (
+                    isinstance(t, jax.Array)
+                    and isinstance(r, jax.Array)
+                    and r.sharding != t.sharding
+                ):
+                    return jax.device_put(r, t.sharding)
+                return r
+
+            return jax.tree.map(place, template, restored)
         return self.manager.restore(step, args=self._ocp.args.PyTreeRestore())
 
     def close(self) -> None:

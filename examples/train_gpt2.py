@@ -53,6 +53,7 @@ class GPT2TrainConfig(Config):
     tp: int = field(1, help="tensor-parallel size")
     attn: str = field("ring", help="attention impl: ring | ulysses | ulysses_flash | ring_flash | flash | xla (flash variants = Pallas kernels)")
     lr: float = field(3e-4, help="peak learning rate")
+    clip_norm: float = field(1.0, help="global-norm gradient clip (0 = off)")
     warmup_steps: int = field(10, help="linear warmup steps")
     seed: int = field(0, help="init/data seed")
     log_every: int = field(10, help="log every N steps")
@@ -168,9 +169,13 @@ def main(argv=None):
         ckpt = Checkpointer(cfg.checkpoint_dir)
         start_step = ckpt.latest_step() or 0
 
-    optimizer = optax.adamw(
-        make_schedule("cosine", cfg.lr, start_step + cfg.steps, cfg.warmup_steps)
-    )
+    schedule_fn = make_schedule("cosine", cfg.lr, start_step + cfg.steps, cfg.warmup_steps)
+    # clip BEFORE the update — spikes from a bad batch can't blow up a bf16
+    # run (the standard LM-training guard). The chain is built for EVERY
+    # clip_norm value (identity when off) so the opt_state pytree structure
+    # — and therefore checkpoint resume — doesn't depend on the flag
+    clip = optax.clip_by_global_norm(cfg.clip_norm) if cfg.clip_norm > 0 else optax.identity()
+    optimizer = optax.chain(clip, optax.adamw(schedule_fn))
     step = make_hybrid_train_step(
         model, optimizer, mesh, attn_impl=cfg.attn, grad_accum=cfg.grad_accum,
         n_microbatches=n_micro, schedule=cfg.schedule,
