@@ -41,11 +41,37 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import subprocess
 import sys
 import time
 
 sys.path.insert(0, ".")
+
+# Soft wall-clock budget: remote compiles over the tunnel cost 30-130 s each
+# and the driver runs this under its own timeout — the HEADLINE section
+# always runs, and each optional section first checks the remaining budget
+# so a slow tunnel degrades to fewer rows instead of no JSON line at all.
+_T0 = time.monotonic()
+try:
+    _BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 1320))
+except ValueError:  # a malformed env var must not cost the JSON line
+    _BUDGET_S = 1320.0
+
+
+def _budget_left() -> float:
+    return _BUDGET_S - (time.monotonic() - _T0)
+
+
+def _skip_for_budget(extras: dict, key: str, need_s: float) -> bool:
+    left = _budget_left()
+    if left < need_s:
+        extras[f"{key}_skipped"] = (
+            f"bench time budget: {left:.0f}s left < {need_s:.0f}s this section needs"
+        )
+        return True
+    return False
+
 
 REFERENCE_SAMPLES_PER_SEC = 1250.0  # 60k × 10 epochs / ~480 s (BASELINE.md)
 REFERENCE_RING_MS = 8.0  # reference ring all-reduce step, 1 MB × 3 simulated devices
@@ -84,40 +110,43 @@ def bench_gpt2() -> dict:
     # long-context row: seq 8192 on one chip — the flash kernel's regime
     # (XLA's fused attention fails to compile at this length); chunked xent
     # keeps the [tokens, vocab] logits out of HBM
-    try:
-        long = _gpt2_train_throughput(batch=1, seq=8192, xent_chunk=8192, k_extra=3, reps=6)
-        out.update(
-            {
-                "gpt2_seq8k_tokens_per_sec": long["tokens_per_sec"],
-                "gpt2_seq8k_mfu": long["mfu"],
-                "gpt2_seq8k_step_ms": long["step_ms"],
-                "gpt2_seq8k_compile_s": long["compile_s"],
-            }
-        )
-    except Exception as e:
-        out["gpt2_seq8k_error"] = repr(e)[:200]
-    # scale row: GPT-2-medium (350M) — MFU climbs with model size (less of
-    # the step is the small-matmul/vocab tail), the don't-stop-at-parity
-    # evidence beyond the BASELINE flagship
-    try:
-        med = _gpt2_train_throughput(batch=4, seq=1024, xent_chunk=0, k_extra=3,
-                                     reps=6, preset="medium")
-        out.update(
-            {
-                "gpt2_medium_tokens_per_sec": med["tokens_per_sec"],
-                "gpt2_medium_mfu": med["mfu"],
-                "gpt2_medium_step_ms": med["step_ms"],
-                "gpt2_medium_params": med["params"],
-            }
-        )
-    except Exception as e:
-        out["gpt2_medium_error"] = repr(e)[:200]
+    if not _skip_for_budget(out, "gpt2_seq8k", 180):
+        try:
+            long = _gpt2_train_throughput(batch=1, seq=8192, xent_chunk=8192, k_extra=3, reps=6)
+            out.update(
+                {
+                    "gpt2_seq8k_tokens_per_sec": long["tokens_per_sec"],
+                    "gpt2_seq8k_mfu": long["mfu"],
+                    "gpt2_seq8k_step_ms": long["step_ms"],
+                    "gpt2_seq8k_compile_s": long["compile_s"],
+                }
+            )
+        except Exception as e:
+            out["gpt2_seq8k_error"] = repr(e)[:200]
     # serving row: greedy KV-cache decode throughput (the reference has no
     # inference path at all)
-    try:
-        out.update(bench_gpt2_decode())
-    except Exception as e:
-        out["gpt2_decode_error"] = repr(e)[:200]
+    if not _skip_for_budget(out, "gpt2_decode", 180):
+        try:
+            out.update(bench_gpt2_decode())
+        except Exception as e:
+            out["gpt2_decode_error"] = repr(e)[:200]
+    # scale row: GPT-2-medium (350M) — MFU climbs with model size (less of
+    # the step is the small-matmul/vocab tail), the don't-stop-at-parity
+    # evidence beyond the BASELINE flagship. Last: biggest compile (~130 s)
+    if not _skip_for_budget(out, "gpt2_medium", 300):
+        try:
+            med = _gpt2_train_throughput(batch=4, seq=1024, xent_chunk=0, k_extra=3,
+                                         reps=6, preset="medium")
+            out.update(
+                {
+                    "gpt2_medium_tokens_per_sec": med["tokens_per_sec"],
+                    "gpt2_medium_mfu": med["mfu"],
+                    "gpt2_medium_step_ms": med["step_ms"],
+                    "gpt2_medium_params": med["params"],
+                }
+            )
+        except Exception as e:
+            out["gpt2_medium_error"] = repr(e)[:200]
     return out
 
 
@@ -445,7 +474,10 @@ def bench_ring_virtual8() -> dict:
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code],
-            capture_output=True, text=True, timeout=600, cwd=".",
+            capture_output=True, text=True, cwd=".",
+            # never overrun the global budget: the gate only guarantees
+            # ~120s remained when this section started
+            timeout=max(min(600.0, _budget_left()), 60.0),
         )
         if proc.returncode != 0 or not proc.stdout.strip():
             return {
@@ -643,15 +675,19 @@ def main() -> None:
                 time.sleep(10.0 * (attempt + 1))
         if last is not None:
             errors["gpt2"] = repr(last)[:300]
-    try:
-        extras.update(bench_mnist())
-    except Exception as e:
-        errors["mnist"] = repr(e)[:300]
-    try:
-        extras.update(bench_ring_allreduce())
-    except Exception as e:
-        errors["allreduce"] = repr(e)[:300]
-    if len(jax.devices()) == 1:
+    # when the flagship failed, mnist is the only remaining MEASURED headline
+    # source — run it regardless of budget rather than print value=null
+    if "gpt2" in errors or not _skip_for_budget(extras, "mnist", 150):
+        try:
+            extras.update(bench_mnist())
+        except Exception as e:
+            errors["mnist"] = repr(e)[:300]
+    if not _skip_for_budget(extras, "allreduce", 90):
+        try:
+            extras.update(bench_ring_allreduce())
+        except Exception as e:
+            errors["allreduce"] = repr(e)[:300]
+    if len(jax.devices()) == 1 and not _skip_for_budget(extras, "allreduce_virtual8", 120):
         # multi-chip hosts already measured a ring that hops on real ICI
         extras.update(bench_ring_virtual8())
     if errors:
