@@ -158,13 +158,7 @@ class GPT2:
                 },
             }
             if cfg.n_experts:
-                layer["moe"] = {
-                    "gate": normal(cfg.d_model, cfg.n_experts),
-                    "w_in": normal(cfg.n_experts, cfg.d_model, cfg.d_ff),
-                    "b_in": zeros(cfg.n_experts, cfg.d_ff),
-                    "w_out": normal(cfg.n_experts, cfg.d_ff, cfg.d_model, std=res_std),
-                    "b_out": zeros(cfg.n_experts, cfg.d_model),
-                }
+                layer["moe"] = self._moe_param_init(normal, res_std)
             else:
                 layer["mlp"] = {
                     "w_in": normal(cfg.d_model, cfg.d_ff),
@@ -200,13 +194,7 @@ class GPT2:
             },
         }
         if cfg.n_experts:
-            layer_spec["moe"] = {
-                "gate": P(),
-                "w_in": P("tp", None, None),  # experts sharded over tp
-                "b_in": P("tp", None),
-                "w_out": P("tp", None, None),
-                "b_out": P("tp", None),
-            }
+            layer_spec["moe"] = self._moe_specs()
         else:
             layer_spec["mlp"] = {
                 "w_in": P(None, "tp"),
@@ -446,6 +434,31 @@ class GPT2:
         if tp_axis:
             out = lax.psum(out, tp_axis)  # Megatron psum #2
         return out + mlp["b_out"]
+
+    def _moe_param_init(self, normal, res_std):
+        """One expert layer's params — shared by every family that mounts
+        the MoE block (GPT-2, Llama/Mixtral), so the layout and
+        ``_moe_block``'s expectations can never drift apart."""
+        cfg = self.config
+        return {
+            "gate": normal(cfg.d_model, cfg.n_experts),
+            "w_in": normal(cfg.n_experts, cfg.d_model, cfg.d_ff),
+            "b_in": jnp.zeros((cfg.n_experts, cfg.d_ff), jnp.dtype(cfg.dtype)),
+            "w_out": normal(cfg.n_experts, cfg.d_ff, cfg.d_model, std=res_std),
+            "b_out": jnp.zeros((cfg.n_experts, cfg.d_model), jnp.dtype(cfg.dtype)),
+        }
+
+    @staticmethod
+    def _moe_specs():
+        from jax.sharding import PartitionSpec as P
+
+        return {
+            "gate": P(),
+            "w_in": P("tp", None, None),  # experts sharded over tp (EP)
+            "b_in": P("tp", None),
+            "w_out": P("tp", None, None),
+            "b_out": P("tp", None),
+        }
 
     def _moe_block(self, moe, x, tp_axis):
         """Top-k gated mixture of experts with experts sharded over

@@ -56,8 +56,13 @@ class LlamaConfig:
     dtype: str = "float32"
     rope_theta: float = 10000.0
     rms_eps: float = 1e-5
-    # shared-plumbing knobs (read by the inherited GPT2 machinery)
-    n_experts: int = 0  # Llama is dense; kept 0 so inherited paths stay dense
+    # Mixtral-style expert parallelism: >0 replaces the SwiGLU MLP with the
+    # inherited capacity-bounded top-k expert layer (token payloads ride
+    # all_to_all over tp — models/gpt2.py::_moe_block; expert MLPs use that
+    # layer's GELU form, the routing/dispatch machinery being the point)
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
     remat: bool | str = False
     xent_chunk: int = 8192
     pp_interleave: int = 1
@@ -87,17 +92,26 @@ class LlamaConfig:
             "tinyllama_1b": cls.tinyllama_1b,
             "llama2_7b": cls.llama2_7b,
             "llama3_8b": cls.llama3_8b,
+            "mixtral_8x7b": cls.mixtral_8x7b,
         }
         if name not in presets:
             raise ValueError(f"unknown Llama preset {name!r}; choose from {sorted(presets)}")
         return presets[name](**tiny_kwargs) if name == "tiny" else presets[name]()
 
     @staticmethod
-    def tiny(vocab_size: int = 512) -> "LlamaConfig":
+    def tiny(vocab_size: int = 512, n_experts: int = 0) -> "LlamaConfig":
         """Test-sized config exercising GQA (8q/2kv), RoPE, SwiGLU."""
         return LlamaConfig(
             vocab_size=vocab_size, max_seq=128, n_layer=2, n_head=8, n_kv_head=2,
-            d_model=64, d_ff=128,
+            d_model=64, d_ff=128, n_experts=n_experts,
+        )
+
+    @staticmethod
+    def mixtral_8x7b() -> "LlamaConfig":
+        """Mixtral-8x7B shape: Llama-2-7B trunk, 8 experts, top-2 routing."""
+        return LlamaConfig(
+            n_layer=32, n_head=32, n_kv_head=8, d_model=4096, d_ff=14336,
+            max_seq=4096, n_experts=8, expert_top_k=2,
         )
 
 
@@ -155,11 +169,17 @@ class Llama(GPT2):
                         "wv": normal(cfg.d_model, kv_d),
                         "wo": normal(cfg.d_model, cfg.d_model, std=res_std),
                     },
-                    "mlp": {
-                        "w_gate": normal(cfg.d_model, cfg.d_ff),
-                        "w_up": normal(cfg.d_model, cfg.d_ff),
-                        "w_down": normal(cfg.d_ff, cfg.d_model, std=res_std),
-                    },
+                    **(
+                        {"moe": self._moe_param_init(normal, res_std)}
+                        if cfg.n_experts
+                        else {
+                            "mlp": {
+                                "w_gate": normal(cfg.d_model, cfg.d_ff),
+                                "w_up": normal(cfg.d_model, cfg.d_ff),
+                                "w_down": normal(cfg.d_ff, cfg.d_model, std=res_std),
+                            }
+                        }
+                    ),
                 }
                 for _ in range(cfg.n_layer)
             ],
@@ -181,12 +201,15 @@ class Llama(GPT2):
                 "wv": P(None, "tp"),
                 "wo": P("tp", None),
             },
-            "mlp": {
+        }
+        if cfg.n_experts:
+            layer_spec["moe"] = self._moe_specs()
+        else:
+            layer_spec["mlp"] = {
                 "w_gate": P(None, "tp"),
                 "w_up": P(None, "tp"),
                 "w_down": P("tp", None),
-            },
-        }
+            }
         if pp:
             from dsml_tpu.parallel.pp import pipeline_specs
 
@@ -265,7 +288,7 @@ class Llama(GPT2):
         if tp_axis:
             out = lax.psum(out, tp_axis)
         h = h + out
-        h = h + self._mlp_block(layer["mlp"], _rms_norm(h, layer["rms_2"]["scale"], cfg.rms_eps), tp_axis)
+        h = self._ffn(layer, h, tp_axis)
         return h
 
     def _mlp_block(self, mlp, x, tp_axis):
@@ -276,9 +299,12 @@ class Llama(GPT2):
         return out
 
     def _ffn(self, layer, h, tp_axis=None):
-        return h + self._mlp_block(
-            layer["mlp"], _rms_norm(h, layer["rms_2"]["scale"], self.config.rms_eps), tp_axis
-        )
+        x = _rms_norm(h, layer["rms_2"]["scale"], self.config.rms_eps)
+        if self.config.n_experts:
+            # Mixtral-style: the inherited capacity-bounded top-k expert
+            # layer — token payloads ride all_to_all over tp (real EP)
+            return h + self._moe_block(layer["moe"], x, tp_axis)
+        return h + self._mlp_block(layer["mlp"], x, tp_axis)
 
     def _hidden_spmd(
         self, params, tokens, tp_axis=None, sp_axis=None, attn_impl="ring",

@@ -36,7 +36,7 @@ from dsml_tpu.utils.config import Config, field
 class GPT2TrainConfig(Config):
     platform: str = field("", help="jax platform override: cpu|tpu ('' = default)")
     cpu_devices: int = field(0, help="virtual CPU device count for --platform cpu")
-    model: str = field("tiny", help="gpt2 family: tiny | small (125M, the BASELINE config) | medium | large | xl; llama family: tiny | tinyllama_1b | llama2_7b | llama3_8b")
+    model: str = field("tiny", help="gpt2 family: tiny | small (125M, the BASELINE config) | medium | large | xl; llama family: tiny | tinyllama_1b | llama2_7b | llama3_8b | mixtral_8x7b")
     family: str = field("gpt2", help="model family: gpt2 | llama (RMSNorm/RoPE/SwiGLU/GQA)")
     dtype: str = field("", help="params/activations dtype: float32 | bfloat16 ('' = model default; bfloat16 feeds the MXU at full rate on TPU)")
     remat: bool = field(False, help="rematerialize each block's activations in backward (less HBM, more FLOPs)")
