@@ -75,3 +75,29 @@ def test_elastic_example_survives_device_loss():
         ["--devices", "8", "--lose", "3", "--fail_at_step", "2", "--steps", "4"]
     )
     assert np.isfinite(loss)
+
+
+def test_mnist_example_reaches_reference_band():
+    """The reference's own workload end-to-end through the example CLI (ring
+    gradient sync on the virtual mesh). Accuracy protocol differs from the
+    reference (train blob stripped; SURVEY §8.11) — assert learning happened,
+    not a specific headline number."""
+    import train_mnist
+
+    acc = train_mnist.main(["--epochs", "2", "--algorithm", "ring", "--batch_size", "128"])
+    assert acc > 0.7, acc
+
+
+def test_model_by_family_dispatch():
+    from dsml_tpu.models import model_by_family
+    from dsml_tpu.models.gpt2 import GPT2
+    from dsml_tpu.models.llama import Llama
+
+    m, cfg = model_by_family("gpt2", "tiny", vocab_size=128)
+    assert type(m) is GPT2 and cfg.vocab_size == 128  # isinstance would pass for Llama (a GPT2 subclass)
+    m2, cfg2 = model_by_family("llama", "mixtral_8x7b")
+    assert isinstance(m2, Llama) and cfg2.n_experts == 8
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown family"):
+        model_by_family("mamba", "tiny")
