@@ -651,10 +651,16 @@ def main() -> None:
         )
 
     errors = {}
-    if tpu_unreachable:
+    if tpu_unreachable or jax.default_backend() == "cpu":
         # a 125M-param train step on the CPU mesh takes minutes/step — skip
-        # the flagship rather than hang the fallback too
-        errors["gpt2"] = "skipped: TPU unreachable (CPU fallback can't run the 125M step)"
+        # the flagship rather than hang. Covers BOTH the dead-tunnel fallback
+        # and an environment whose default backend is genuinely CPU (the
+        # liveness preflight passes there, so it alone can't catch this)
+        errors["gpt2"] = (
+            "skipped: TPU unreachable (CPU fallback can't run the 125M step)"
+            if tpu_unreachable
+            else "skipped: default backend is CPU (no accelerator to measure)"
+        )
     else:
         # the tunneled chip's remote-compile endpoint drops connections under
         # long compiles ("response body closed before all bytes were read");
