@@ -651,7 +651,16 @@ def main() -> None:
         )
 
     errors = {}
-    if tpu_unreachable or jax.default_backend() == "cpu":
+    cpu_only = jax.default_backend() == "cpu"
+    no_tpu_signal = tpu_unreachable or cpu_only
+    if cpu_only and not tpu_unreachable:
+        # genuine-CPU environments need the same machine-readable marker the
+        # dead-tunnel path sets, or a driver filtering CPU-contaminated runs
+        # by flag would record this as a real accelerator measurement
+        extras["cpu_only_backend"] = (
+            "default backend is CPU; numbers carry NO TPU performance signal"
+        )
+    if no_tpu_signal:
         # a 125M-param train step on the CPU mesh takes minutes/step — skip
         # the flagship rather than hang. Covers BOTH the dead-tunnel fallback
         # and an environment whose default backend is genuinely CPU (the
@@ -710,8 +719,10 @@ def main() -> None:
         ),
         "cifar10_resnet_example": "synthetic data by default (examples/train_cifar_resnet.py)",
         "allreduce_real_chip": (
-            "VIRTUAL CPU mesh (TPU unreachable) — no TPU signal"
-            if tpu_unreachable
+            ("VIRTUAL CPU mesh (TPU unreachable) — no TPU signal"
+             if tpu_unreachable
+             else "CPU default backend — no TPU signal")
+            if no_tpu_signal
             else "real device, 1 MB payload"
         ),
         "allreduce_virtual8": "8-device virtual CPU mesh — harness proof, not ICI",

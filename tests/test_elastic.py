@@ -208,3 +208,27 @@ def test_elastic_restack_for_new_pipeline(devices8, monkeypatch):
     step2 = make_hybrid_train_step(model, opt, st.mesh, attn_impl="ring", n_microbatches=2)
     _, _, loss = step2(st.params, st.opt_state, x, y)
     assert np.isfinite(float(loss))
+
+
+def test_elastic_is_model_generic_llama(devices8):
+    """reconfigure works for the Llama family too (param_specs/n_params are
+    the only model hooks it uses — the model-generic claim)."""
+    from dsml_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny()
+    model = Llama(cfg)
+    opt = optax.adam(1e-2)
+    mesh8 = build_mesh(MeshSpec(dp=4, sp=1, tp=2), devices8)
+    step = make_hybrid_train_step(model, opt, mesh8, attn_impl="ring")
+    params, opt_state = init_hybrid(model, opt, mesh8, seed=0)
+    x, y = _data(cfg)
+    params, opt_state, l0 = step(params, opt_state, x, y)
+
+    state = reconfigure(
+        model, opt, params, opt_state,
+        surviving_devices=devices8[:4], lost_devices=devices8[4:],
+        global_batch=8,
+    )
+    step2 = make_hybrid_train_step(model, opt, state.mesh, attn_impl="ring")
+    _, _, l1 = step2(state.params, state.opt_state, x, y)
+    assert np.isfinite(float(l1)) and float(l1) < float(l0) + 0.5
