@@ -653,12 +653,14 @@ def main() -> None:
     errors = {}
     cpu_only = jax.default_backend() == "cpu"
     no_tpu_signal = tpu_unreachable or cpu_only
-    if cpu_only and not tpu_unreachable:
-        # genuine-CPU environments need the same machine-readable marker the
-        # dead-tunnel path sets, or a driver filtering CPU-contaminated runs
-        # by flag would record this as a real accelerator measurement
-        extras["cpu_only_backend"] = (
-            "default backend is CPU; numbers carry NO TPU performance signal"
+    if no_tpu_signal:
+        # ONE shared machine-readable key for every no-signal path (the
+        # path-specific detail is the value) — a driver filtering
+        # CPU-contaminated runs needs a single flag to check
+        extras["no_tpu_signal"] = (
+            "TPU unreachable (dead tunnel); CPU-mesh fallback"
+            if tpu_unreachable
+            else "default backend is CPU; numbers carry NO TPU performance signal"
         )
     if no_tpu_signal:
         # a 125M-param train step on the CPU mesh takes minutes/step — skip

@@ -233,12 +233,13 @@ def test_multislice_mesh_runs_hybrid_step(devices8):
     from dsml_tpu.parallel.mesh import MeshSpec, multislice_mesh
 
     mesh = multislice_mesh(MeshSpec(dp=4, tp=2), devices8)
-    model = GPT2(GPT2Config.tiny())
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
     opt = optax.adam(1e-2)
     step = make_hybrid_train_step(model, opt, mesh, attn_impl="ring")
     params, opt_state = init_hybrid(model, opt, mesh, seed=0)
     rng = np.random.default_rng(1)
-    x = rng.integers(0, 512, (8, 128)).astype(np.int32)
+    x = rng.integers(0, cfg.vocab_size, (8, cfg.max_seq)).astype(np.int32)
     y = np.roll(x, -1, 1).astype(np.int32)
     losses = []
     for _ in range(3):
