@@ -656,22 +656,18 @@ def main() -> None:
     if no_tpu_signal:
         # ONE shared machine-readable key for every no-signal path (the
         # path-specific detail is the value) — a driver filtering
-        # CPU-contaminated runs needs a single flag to check
-        extras["no_tpu_signal"] = (
-            "TPU unreachable (dead tunnel); CPU-mesh fallback"
-            if tpu_unreachable
-            else "default backend is CPU; numbers carry NO TPU performance signal"
-        )
-    if no_tpu_signal:
-        # a 125M-param train step on the CPU mesh takes minutes/step — skip
-        # the flagship rather than hang. Covers BOTH the dead-tunnel fallback
-        # and an environment whose default backend is genuinely CPU (the
-        # liveness preflight passes there, so it alone can't catch this)
-        errors["gpt2"] = (
-            "skipped: TPU unreachable (CPU fallback can't run the 125M step)"
-            if tpu_unreachable
-            else "skipped: default backend is CPU (no accelerator to measure)"
-        )
+        # CPU-contaminated runs needs a single flag to check. The flagship
+        # is skipped in the same breath: a 125M-param train step on the CPU
+        # mesh takes minutes/step (the liveness preflight passes on a live
+        # CPU default device, so it alone can't catch the genuine-CPU case)
+        if tpu_unreachable:
+            extras["no_tpu_signal"] = "TPU unreachable (dead tunnel); CPU-mesh fallback"
+            errors["gpt2"] = "skipped: TPU unreachable (CPU fallback can't run the 125M step)"
+        else:
+            extras["no_tpu_signal"] = (
+                "default backend is CPU; numbers carry NO TPU performance signal"
+            )
+            errors["gpt2"] = "skipped: default backend is CPU (no accelerator to measure)"
     else:
         # the tunneled chip's remote-compile endpoint drops connections under
         # long compiles ("response body closed before all bytes were read");

@@ -385,3 +385,29 @@ def test_comm_finalize_drains_and_destroys(cluster):
     with pytest.raises(grpc.RpcError) as e:
         client.status()
     assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_wire_all_reduce_with_auto_algorithm(devices8):
+    """The wire coordinator accepts algorithm='auto' — the Blink/TACOS
+    payload-aware selection rides the gRPC AllReduceRing surface. 4 devices
+    with a 200 KB payload sit in auto's RING regime (crossover ≈ 160 KB at
+    n=4), so the bandwidth-optimal branch is the one exercised here; the
+    rule itself is unit-tested in test_collectives."""
+    from dsml_tpu.comm.client import PipelineClient
+    from dsml_tpu.comm.coordinator import CoordinatorConfig, serve_coordinator
+    from dsml_tpu.comm.device_server import serve_local_devices
+
+    devices = []
+    coordinator = None
+    try:
+        devices = serve_local_devices(4, base_device_id=80, mem_size=0x100000)
+        coordinator = serve_coordinator(config=CoordinatorConfig(ring_algorithm="auto"))
+        client = PipelineClient.connect(coordinator.address, [d.address for d in devices])
+        grads = [np.full(50_000, float(r + 1), np.float32) for r in range(4)]
+        reduced = client.all_reduce_gradients(grads)  # write → ring RPC → read
+        np.testing.assert_array_equal(reduced, np.full(50_000, 10.0))  # 1+2+3+4
+    finally:
+        if coordinator is not None:
+            coordinator.stop()
+        for d in devices:
+            d.stop()
