@@ -471,3 +471,26 @@ def test_tp_requires_divisible_heads(devices8):
                 check_vma=False,
             )
         )(model.init(0), np.zeros((8, 64), np.int32))
+
+
+def test_interleaved_pipeline_with_int8_remat(pp_mesh8):
+    """Composition pin: interleaved virtual stages AND compressed int8 remat
+    in one step — the chunk-level compressed_checkpoint rides inside the
+    interleaved scan's dynamic chunk indexing."""
+    import dataclasses
+
+    cfg = dataclasses.replace(GPT2Config.tiny(), n_layer=4, pp_interleave=2, remat="int8")
+    model = GPT2(cfg)
+    plain = GPT2(dataclasses.replace(cfg, remat=False))
+    x, y = _batch(cfg, batch=8, seed=41)
+    optimizer = optax.adam(1e-3)
+
+    step = make_hybrid_train_step(model, optimizer, pp_mesh8, n_microbatches=2)
+    params, opt_state = init_hybrid(model, optimizer, pp_mesh8, seed=40)
+    params, opt_state, loss = step(params, opt_state, x, y)
+    # forward identical (compression touches only the backward stash)
+    ref = float(jax.jit(plain.loss)(plain.init(40), x, y))
+    np.testing.assert_allclose(float(loss), ref, rtol=5e-4)
+    # training continues finite and downward
+    _, _, loss2 = step(params, opt_state, x, y)
+    assert np.isfinite(float(loss2)) and float(loss2) < float(loss)
