@@ -197,9 +197,13 @@ def compressed_checkpoint(fn, seed: jax.Array | int | None = None):
             if seed is None:
                 # fold the activation's own bits into the seed: changes every
                 # step/layer because the values do, costs one reduction over
-                # a tensor already in registers
-                leaf_seed = lax.bitcast_convert_type(
-                    jnp.sum(leaf.astype(jnp.float32)), jnp.int32
+                # a tensor already in registers. Sum the int32 BITCASTS, not
+                # the floats: an f32 sum can saturate to inf/NaN on large
+                # bf16 tensors, freezing the seed into a step-constant and
+                # reintroducing the correlated-rounding bias; int32 addition
+                # wraps, so the reduction is total and value-dependent
+                leaf_seed = jnp.sum(
+                    lax.bitcast_convert_type(leaf.astype(jnp.float32), jnp.int32)
                 )
             else:
                 leaf_seed = seed

@@ -35,6 +35,7 @@ from __future__ import annotations
 import dataclasses
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -51,7 +52,10 @@ class ElasticPolicy:
     ``allow_shrink`` — re-plan onto the survivors (False = fail fast, the
     reference's behavior). ``require_full_state`` — refuse to continue if
     any state leaf was exclusively sharded on lost devices (True means: fall
-    back to your checkpoint instead of silently training on a torn state).
+    back to your checkpoint instead of silently training on a torn state;
+    False means: continue anyway, with torn leaves explicitly ZERO-FILLED —
+    never fetched from dead devices — and the substitution recorded in the
+    reconfiguration's audit trail).
     """
 
     allow_shrink: bool = True
@@ -77,6 +81,43 @@ def _leaf_shardings(tree):
     ]
 
 
+def _piece_key(idx, shape) -> tuple:
+    """Canonical (start, stop) tuple for a shard's index — normalized via
+    ``slice.indices`` so ``slice(None)`` and ``slice(0, n)`` agree between
+    ``devices_indices_map`` and ``Shard.index``."""
+    return tuple(
+        s.indices(dim)[:2] for s, dim in zip(idx, shape) if isinstance(s, slice)
+    )
+
+
+def _piece_holders(leaf, sharding) -> dict:
+    """piece key → list of holder device ids (devices_indices_map groups:
+    every device holding the same index tuple holds the same data)."""
+    holders: dict = {}
+    for dev, idx in sharding.devices_indices_map(leaf.shape).items():
+        holders.setdefault(_piece_key(idx, leaf.shape), []).append(dev.id)
+    return holders
+
+
+def _torn_leaves(state, lost_devices) -> list[tuple[object, str]]:
+    """(leaf, description) for every state leaf with at least one piece that
+    lives ONLY on lost devices. Shared by the audit (:func:`check_recoverable`)
+    and the torn-state continuation path in :func:`reconfigure`, so the two
+    can never disagree about what "torn" means."""
+    lost = {d.id for d in lost_devices}
+    torn: list[tuple[object, str]] = []
+    for leaf, sharding in _leaf_shardings(state):
+        if sharding is None:  # host array: nothing to lose
+            continue
+        for piece, devs in _piece_holders(leaf, sharding).items():
+            if all(d in lost for d in devs):
+                torn.append(
+                    (leaf, f"shape={leaf.shape} piece={piece} only on lost devices {devs}")
+                )
+                break
+    return torn
+
+
 def check_recoverable(state, lost_devices) -> list[str]:
     """Which state leaves would be LOST if ``lost_devices`` die right now?
 
@@ -85,22 +126,7 @@ def check_recoverable(state, lost_devices) -> list[str]:
     sits outside ``lost_devices``. Returns a list of human-readable
     descriptions of unrecoverable leaves (empty = fully recoverable, the
     state every DP/replicated layout gives you)."""
-    lost = {d.id for d in lost_devices}
-    torn: list[str] = []
-    for leaf, sharding in _leaf_shardings(state):
-        if sharding is None:  # host array: nothing to lose
-            continue
-        # group shards by the data they hold (device_indices_map: device →
-        # index tuple); a piece is safe iff some holder survives
-        holders: dict = {}
-        for dev, idx in sharding.devices_indices_map(leaf.shape).items():
-            key = tuple((s.start, s.stop) for s in idx if isinstance(s, slice))
-            holders.setdefault(key, []).append(dev.id)
-        for piece, devs in holders.items():
-            if all(d in lost for d in devs):
-                torn.append(f"shape={leaf.shape} piece={piece} only on lost devices {devs}")
-                break
-    return torn
+    return [descr for _, descr in _torn_leaves(state, lost_devices)]
 
 
 def reconfigure(
@@ -136,12 +162,25 @@ def reconfigure(
             f"{len(lost_devices)} device(s) lost and ElasticPolicy.allow_shrink=False "
             "(reference semantics: communicator FAILED, job dead)"
         )
-    if policy.require_full_state and lost_devices:
-        torn = check_recoverable((params, opt_state), lost_devices)
-        if torn:
+    torn_note: tuple[str, ...] = ()
+    if lost_devices:
+        torn = _torn_leaves((params, opt_state), lost_devices)
+        if torn and policy.require_full_state:
             raise RuntimeError(
                 "training state not recoverable from survivors — restore from "
-                f"checkpoint instead; torn leaves: {torn[:3]}"
+                f"checkpoint instead; torn leaves: {[d for _, d in torn[:3]]}"
+            )
+        if torn:
+            # require_full_state=False: the caller chose to continue on a
+            # torn state; the pieces whose holders all died are explicitly
+            # ZERO-FILLED in the host round-trip below (never fetched from
+            # dead devices), and the substitution is recorded in the audit
+            # trail. (Zeros are the deterministic, honest choice: lost
+            # optimizer moments restart cold, lost param shards retrain;
+            # anything cleverer belongs in the checkpoint fallback.)
+            torn_note = (
+                f"require_full_state=False: zero-filled the lost pieces of "
+                f"{len(torn)} torn leaf/leaves: " + "; ".join(d for _, d in torn[:3]),
             )
 
     cfg = getattr(model, "config", None)
@@ -175,11 +214,49 @@ def reconfigure(
     assert plan is not None  # n_use=1 always divides
     new_mesh = build_mesh(plan.spec, survivors)
 
-    # host round-trip: survivors hold every piece (audited above), so
-    # device_get reassembles full values; device_put lays them out fresh
+    # host round-trip: survivors hold every piece (audited above, unless the
+    # caller accepted a torn state — those pieces substitute zeros); any leaf
+    # touching a dead device is reassembled from surviving shards, never
+    # fetched whole; device_put lays the state out fresh on the new mesh
     pspecs = model.param_specs(pp=plan.spec.pp > 1)
-    host_params = jax.device_get(params)
-    host_opt = jax.device_get(opt_state)
+
+    lost_ids = {d.id for d in lost_devices}
+
+    def pull(leaf):
+        sharding = getattr(leaf, "sharding", None)
+        if (
+            not isinstance(leaf, jax.Array)
+            or sharding is None
+            or not lost_ids
+            or not any(d.id in lost_ids for d in sharding.device_set)
+        ):
+            # no shard of this leaf touches a dead device: plain fetch
+            return jax.device_get(leaf)
+        # some holder died (torn or not): NEVER device_get the whole leaf —
+        # that would materialize dead shards and hang on a real loss.
+        # Reassemble piecewise from surviving addressable shards; pieces
+        # whose holders all died stay zero (audited above); a piece that
+        # survives only on a NON-addressable device (another host) can't be
+        # fetched from here — refuse loudly rather than zero silently-good
+        # data the audit said was safe
+        out = np.zeros(leaf.shape, jnp.dtype(leaf.dtype))
+        filled: set = set()
+        for shard in leaf.addressable_shards:
+            if shard.device.id not in lost_ids:
+                out[shard.index] = np.asarray(shard.data)
+                filled.add(_piece_key(shard.index, leaf.shape))
+        for piece, devs in _piece_holders(leaf, sharding).items():
+            if piece in filled or all(d in lost_ids for d in devs):
+                continue
+            raise RuntimeError(
+                f"piece {piece} of a shape-{leaf.shape} leaf survives only on "
+                f"non-addressable devices {devs}; cross-host state motion is "
+                "not implemented — restore from checkpoint on this host instead"
+            )
+        return out
+
+    host_params = jax.tree.map(pull, params)
+    host_opt = jax.tree.map(pull, opt_state)
     if old_pp:
         # the failed mesh ran a pipeline (stacked layer axis, possibly in
         # interleave-permuted order for the OLD stage count) — always return
@@ -285,5 +362,5 @@ def reconfigure(
     )
     return ElasticState(
         params=new_params, opt_state=new_opt, mesh=new_mesh, spec=plan.spec,
-        reasons=plan.reasons,
+        reasons=plan.reasons + torn_note,
     )

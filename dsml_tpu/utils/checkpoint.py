@@ -59,8 +59,11 @@ class Checkpointer:
         self.manager.save(step, args=self._ocp.args.PyTreeSave(state))
         if wait:
             self.manager.wait_until_finished()
-        log.info("saved checkpoint step %d -> %s%s", step, self.directory,
-                 "" if wait else " (async)")
+            log.info("saved checkpoint step %d -> %s", step, self.directory)
+        else:
+            # the background write hasn't committed yet — a "saved" line here
+            # would claim a checkpoint that a crash could still lose
+            log.info("scheduled async checkpoint save step %d -> %s", step, self.directory)
 
     def wait_until_finished(self) -> None:
         """Block until any in-flight async save has committed."""

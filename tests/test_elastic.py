@@ -151,6 +151,49 @@ def test_require_full_state_refuses_torn_state(devices8):
         )
 
 
+def test_torn_state_zero_fill_continuation(devices8):
+    """ElasticPolicy(require_full_state=False): continuing on a torn state
+    zero-fills ONLY the pieces whose holders died (surviving shards are
+    reassembled), never device_gets a dead shard, records the substitution
+    in the audit trail, and the re-planned mesh still trains."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    opt = optax.adam(1e-2)
+    x, y = _data(cfg)
+    # mesh layout [dp=4, tp=2]: device i holds tp rank i%2 — losing the odd
+    # devices removes tp shard 1 of every tp-sharded leaf entirely
+    mesh8 = build_mesh(MeshSpec(dp=4, sp=1, tp=2), devices8)
+    step = make_hybrid_train_step(model, opt, mesh8, attn_impl="ring")
+    params, opt_state = init_hybrid(model, opt, mesh8, seed=0)
+    params, opt_state, _ = step(params, opt_state, x, y)
+    ref_wqkv = np.asarray(jax.device_get(params["layers"][0]["attn"]["wqkv"]))
+    ref_wpe = np.asarray(jax.device_get(params["wpe"]))
+
+    lost = [devices8[i] for i in (1, 3, 5, 7)]
+    surv = [devices8[i] for i in (0, 2, 4, 6)]
+    assert check_recoverable((params, opt_state), lost)  # genuinely torn
+    state = reconfigure(
+        model, opt, params, opt_state, surviving_devices=surv, lost_devices=lost,
+        policy=ElasticPolicy(require_full_state=False),
+    )
+    assert any("zero-filled" in r for r in state.reasons)
+    got_wqkv = np.asarray(jax.device_get(state.params["layers"][0]["attn"]["wqkv"]))
+    d = cfg.d_model
+    half = d // 2
+    # tp shard 0 (first half of the last dim) survived intact; shard 1's
+    # holders all died → zero-filled
+    np.testing.assert_array_equal(got_wqkv[..., :half], ref_wqkv[..., :half])
+    assert np.all(got_wqkv[..., half:] == 0)
+    assert np.any(ref_wqkv[..., half:] != 0)  # the zeros are substitutions
+    # replicated leaves (every device holds a full copy) survive untouched
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(state.params["wpe"])), ref_wpe
+    )
+    step2 = make_hybrid_train_step(model, opt, state.mesh, attn_impl="ring")
+    _, _, loss = step2(state.params, state.opt_state, x, y)
+    assert np.isfinite(float(loss))
+
+
 def test_awkward_survivor_count_idles_devices(devices8):
     """5 survivors for a global batch of 4: the plan instantiates on the
     largest workable subset (Oobleck: n-1 busy chips beat a crash)."""
