@@ -1,4 +1,5 @@
-"""Shared model utilities: initializers and classification losses."""
+"""Shared model utilities: initializers, classification losses, and the
+FSDP spec transform every family's ``param_specs`` routes through."""
 
 from __future__ import annotations
 
@@ -6,7 +7,36 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["he_init", "softmax_xent", "count_correct"]
+__all__ = ["he_init", "softmax_xent", "count_correct", "with_fsdp", "fsdp_spec_fn"]
+
+
+def with_fsdp(spec, shape: tuple, fsdp: int, axis: str = "fsdp"):
+    """Add ``axis`` to ``spec`` on the first UNSHARDED dim of ``shape`` that
+    divides by ``fsdp`` (the ZeRO-3 rule ``parallel.fsdp.fsdp_shardings``
+    applies to NamedShardings, here at the PartitionSpec level so it composes
+    with TP/PP inside one spec). Leaves with no divisible free dim stay as
+    given (replicated over fsdp) — small norms/biases, where sharding buys
+    nothing. ``shape`` is the GLOBAL (unstacked) leaf shape; callers state it
+    analytically next to the spec, and the placement itself verifies it:
+    ``device_put``/``shard_map`` reject indivisible dims, so a drifted shape
+    can't silently mis-shard."""
+    from jax.sharding import PartitionSpec as P
+
+    if fsdp <= 1:
+        return spec
+    parts = list(spec) + [None] * (len(shape) - len(spec))
+    for i, (p, n) in enumerate(zip(parts, shape)):
+        if p is None and n % fsdp == 0 and n >= fsdp:
+            parts[i] = axis
+            return P(*parts)
+    return spec
+
+
+def fsdp_spec_fn(fsdp: int, axis: str = "fsdp"):
+    """``F(spec, *shape)`` adapter over :func:`with_fsdp` — the one-liner
+    every ``param_specs`` implementation binds, kept here so the call shape
+    can't drift between model families."""
+    return lambda spec, *shape: with_fsdp(spec, shape, fsdp, axis)
 
 
 def he_init(rng: np.random.Generator, *shape: int, fan_in: int) -> jax.Array:

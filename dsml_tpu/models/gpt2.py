@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dsml_tpu.models.common import fsdp_spec_fn
 from dsml_tpu.ops.attention import _NEG_INF, attention, ring_attention, ulysses_attention
 
 __all__ = ["GPT2Config", "GPT2"]
@@ -174,33 +175,40 @@ class GPT2:
 
     # ---- sharding rules (GSPMD specs over the framework mesh axes) -------------
 
-    def param_specs(self, pp: bool = False) -> dict:
+    def param_specs(self, pp: bool = False, fsdp: int = 1) -> dict:
         """PartitionSpec pytree: Megatron TP sharding over 'tp', everything
-        else replicated (dp/sp replicate params; fsdp would further shard —
-        see parallel.fsdp). With ``pp=True`` the layer list is expected
-        STACKED (leading layer axis, ``parallel.pp.stack_layer_params``) and
-        sharded over the 'pp' axis so each rank holds its pipeline stage."""
+        else replicated (dp/sp replicate params). With ``pp=True`` the layer
+        list is expected STACKED (leading layer axis,
+        ``parallel.pp.stack_layer_params``) and sharded over the 'pp' axis so
+        each rank holds its pipeline stage. With ``fsdp > 1`` every leaf is
+        additionally ZeRO-sharded over the 'fsdp' axis on its first free
+        divisible dim (``models.common.with_fsdp``); the hybrid step gathers
+        weights just-in-time and reduce-scatters gradients
+        (``parallel.hybrid``), so fsdp composes with tp/pp/sp in one mesh."""
         from jax.sharding import PartitionSpec as P
 
         cfg = self.config
+        d, ff, V, S = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.max_seq
+        F = fsdp_spec_fn(fsdp)
         layer_spec = {
-            "ln_1": {"scale": P(), "bias": P()},
-            "ln_2": {"scale": P(), "bias": P()},
+            "ln_1": {"scale": F(P(), d), "bias": F(P(), d)},
+            "ln_2": {"scale": F(P(), d), "bias": F(P(), d)},
             "attn": {
-                "wqkv": P(None, None, "tp"),  # column-parallel (heads split)
-                "bqkv": P(None, "tp"),
-                "wo": P("tp", None),  # row-parallel
-                "bo": P(),
+                # column-parallel (heads split); fsdp takes the input dim
+                "wqkv": F(P(None, None, "tp"), d, 3, d),
+                "bqkv": F(P(None, "tp"), 3, d),
+                "wo": F(P("tp", None), d, d),  # row-parallel
+                "bo": F(P(), d),
             },
         }
         if cfg.n_experts:
-            layer_spec["moe"] = self._moe_specs()
+            layer_spec["moe"] = self._moe_specs(fsdp)
         else:
             layer_spec["mlp"] = {
-                "w_in": P(None, "tp"),
-                "b_in": P("tp"),
-                "w_out": P("tp", None),
-                "b_out": P(),
+                "w_in": F(P(None, "tp"), d, ff),
+                "b_in": F(P("tp"), ff),
+                "w_out": F(P("tp", None), ff, d),
+                "b_out": F(P(), d),
             }
         if pp:
             from dsml_tpu.parallel.pp import pipeline_specs
@@ -209,9 +217,9 @@ class GPT2:
         else:
             layers_spec = [layer_spec for _ in range(cfg.n_layer)]
         return {
-            "wte": P("tp", None),  # vocab-sharded embedding/unembedding
-            "wpe": P(),
-            "ln_f": {"scale": P(), "bias": P()},
+            "wte": F(P("tp", None), V, d),  # vocab-sharded embedding/unembedding
+            "wpe": F(P(), S, d),
+            "ln_f": {"scale": F(P(), d), "bias": F(P(), d)},
             "layers": layers_spec,
         }
 
@@ -448,16 +456,18 @@ class GPT2:
             "b_out": jnp.zeros((cfg.n_experts, cfg.d_model), jnp.dtype(cfg.dtype)),
         }
 
-    @staticmethod
-    def _moe_specs():
+    def _moe_specs(self, fsdp: int = 1):
         from jax.sharding import PartitionSpec as P
 
+        cfg = self.config
+        d, ff, E = cfg.d_model, cfg.d_ff, cfg.n_experts
+        F = fsdp_spec_fn(fsdp)
         return {
-            "gate": P(),
-            "w_in": P("tp", None, None),  # experts sharded over tp (EP)
-            "b_in": P("tp", None),
-            "w_out": P("tp", None, None),
-            "b_out": P("tp", None),
+            "gate": F(P(), d, E),
+            "w_in": F(P("tp", None, None), E, d, ff),  # experts sharded over tp (EP)
+            "b_in": F(P("tp", None), E, ff),
+            "w_out": F(P("tp", None, None), E, ff, d),
+            "b_out": F(P("tp", None), E, d),
         }
 
     def _moe_block(self, moe, x, tp_axis):

@@ -186,29 +186,36 @@ class Llama(GPT2):
         }
         return params
 
-    def param_specs(self, pp: bool = False) -> dict:
+    def param_specs(self, pp: bool = False, fsdp: int = 1) -> dict:
         """Megatron sharding: q/k/v/gate/up column-parallel (head split for
-        q/k/v), wo/w_down row-parallel, vocab matrices vocab-sharded."""
+        q/k/v), wo/w_down row-parallel, vocab matrices vocab-sharded; with
+        ``fsdp > 1`` each leaf is additionally ZeRO-sharded on its first
+        free divisible dim (``models.common.with_fsdp``)."""
         from jax.sharding import PartitionSpec as P
 
+        from dsml_tpu.models.common import fsdp_spec_fn
+
         cfg = self.config
+        d, ff, V = cfg.d_model, cfg.d_ff, cfg.vocab_size
+        kv_d = cfg.n_kv_head * (cfg.d_model // cfg.n_head)
+        F = fsdp_spec_fn(fsdp)
         layer_spec = {
-            "rms_1": {"scale": P()},
-            "rms_2": {"scale": P()},
+            "rms_1": {"scale": F(P(), d)},
+            "rms_2": {"scale": F(P(), d)},
             "attn": {
-                "wq": P(None, "tp"),
-                "wk": P(None, "tp"),
-                "wv": P(None, "tp"),
-                "wo": P("tp", None),
+                "wq": F(P(None, "tp"), d, d),
+                "wk": F(P(None, "tp"), d, kv_d),
+                "wv": F(P(None, "tp"), d, kv_d),
+                "wo": F(P("tp", None), d, d),
             },
         }
         if cfg.n_experts:
-            layer_spec["moe"] = self._moe_specs()
+            layer_spec["moe"] = self._moe_specs(fsdp)
         else:
             layer_spec["mlp"] = {
-                "w_gate": P(None, "tp"),
-                "w_up": P(None, "tp"),
-                "w_down": P("tp", None),
+                "w_gate": F(P(None, "tp"), d, ff),
+                "w_up": F(P(None, "tp"), d, ff),
+                "w_down": F(P("tp", None), ff, d),
             }
         if pp:
             from dsml_tpu.parallel.pp import pipeline_specs
@@ -217,9 +224,9 @@ class Llama(GPT2):
         else:
             layers = [layer_spec for _ in range(cfg.n_layer)]
         return {
-            "wte": P("tp", None),
-            "lm_head": P("tp", None),
-            "rms_f": {"scale": P()},
+            "wte": F(P("tp", None), V, d),
+            "lm_head": F(P("tp", None), V, d),
+            "rms_f": {"scale": F(P(), d)},
             "layers": layers,
         }
 

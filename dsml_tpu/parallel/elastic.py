@@ -139,6 +139,7 @@ def reconfigure(
     policy: ElasticPolicy = ElasticPolicy(),
     batch_per_device: int = 1,
     global_batch: int | None = None,
+    planner_overrides: dict | None = None,
 ) -> ElasticState:
     """Continue training on the survivor fleet.
 
@@ -148,9 +149,13 @@ def reconfigure(
     2. Re-plan parallelism for ``len(surviving_devices)`` chips with the
        capacity-rule planner (the Oobleck template re-instantiation). With
        ``global_batch`` set, the plan must also keep the batch divisible by
-       its dp width — survivor counts that can't (e.g. 5 chips for a batch
-       of 4) instantiate the template on the largest workable device SUBSET
+       its dp×fsdp width (both axes shard batch rows in the hybrid step) —
+       survivor counts that can't (e.g. 5 chips for a batch of 4)
+       instantiate the template on the largest workable device SUBSET
        and idle the rest, Oobleck's choice: n−1 busy chips beat a crash.
+       ``planner_overrides`` forwards capacity inputs to ``plan_mesh``
+       (measured ``hbm_bytes``/``act_bytes``, budget fractions) so the
+       re-plan uses the same hardware facts the original plan did.
     3. Pull state to host once and re-shard onto the new mesh.
 
     Returns :class:`ElasticState` with the new (params, opt_state, mesh);
@@ -196,8 +201,13 @@ def reconfigure(
             d_model=getattr(cfg, "d_model", 0),
             n_layer=getattr(cfg, "n_layer", 0),
             batch_per_device=batch_per_device,
+            **(planner_overrides or {}),
         )
-        if global_batch is None or global_batch % candidate.spec.dp == 0:
+        # the hybrid step shards batch rows over dp × fsdp (fsdp doubles as
+        # a data axis), so BOTH must divide the batch for the plan to run
+        if global_batch is None or global_batch % (
+            candidate.spec.dp * candidate.spec.fsdp
+        ) == 0:
             plan = candidate
             if n_use < len(survivors):
                 plan = dataclasses.replace(
@@ -205,7 +215,7 @@ def reconfigure(
                     reasons=plan.reasons
                     + (
                         f"global batch {global_batch} not divisible by the "
-                        f"{len(survivors)}-chip plan's dp → instantiated on "
+                        f"{len(survivors)}-chip plan's dp×fsdp → instantiated on "
                         f"{n_use} chips, {len(survivors) - n_use} idle",
                     ),
                 )
@@ -218,7 +228,7 @@ def reconfigure(
     # caller accepted a torn state — those pieces substitute zeros); any leaf
     # touching a dead device is reassembled from surviving shards, never
     # fetched whole; device_put lays the state out fresh on the new mesh
-    pspecs = model.param_specs(pp=plan.spec.pp > 1)
+    pspecs = model.param_specs(pp=plan.spec.pp > 1, fsdp=plan.spec.fsdp)
 
     lost_ids = {d.id for d in lost_devices}
 

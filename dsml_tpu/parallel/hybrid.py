@@ -1,4 +1,5 @@
-"""Hybrid-parallel training: pp × dp × sp × tp in one jitted mesh program.
+"""Hybrid-parallel training: pp × dp × fsdp × sp × tp in one jitted mesh
+program.
 
 The composable-mesh-axes design the reference's literature corpus points at
 (Megatron PTD-P, OneFlow SBP, Colossal-AI — SURVEY.md §2.3 "hybrid
@@ -7,7 +8,14 @@ parallelism: literature only") realized for the transformer:
 - params enter TP-sharded (``GPT2.param_specs``), replicated over dp/sp;
   with pp > 1 the layer stack is stage-sharded over 'pp' and runs as a
   GPipe pipeline (``parallel.pp``) inside the same step;
-- the batch enters ``P('dp', 'sp')`` (batch rows over dp, sequence over sp);
+- with fsdp > 1 every param leaf is additionally ZeRO-sharded over the
+  'fsdp' axis (``with_fsdp`` specs): inside the per-rank program weights
+  are ``all_gather``-ed just-in-time, and the shard_map transpose of that
+  gather IS the gradient reduce-scatter — the ZeRO-3 communication
+  pattern, spelled as one collective whose autodiff does the rest.
+  Optimizer state inherits the sharded layout (ZeRO-1/2 for free);
+- the batch enters ``P(('dp','fsdp'), 'sp')`` (batch rows over dp and
+  fsdp — fsdp doubles as a data axis, as in ZeRO — sequence over sp);
 - inside ``shard_map``, the model runs Megatron TP psums + ring/Ulysses
   sequence-parallel attention; differentiation happens OUTSIDE shard_map so
   every collective's transpose assigns cotangents exactly once;
@@ -35,6 +43,22 @@ def shard_params(params, mesh: Mesh, specs) -> dict:
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs,
         is_leaf=lambda x: isinstance(x, P),
     )
+
+
+def gather_fsdp(params, pspecs, axis: str = "fsdp"):
+    """Reconstruct full weights from their ZeRO shards inside the per-rank
+    program: one tiled ``all_gather`` over ``axis`` per fsdp-sharded leaf.
+    Under ``jax.grad`` of the surrounding shard_map, the transpose of each
+    gather is a ``psum_scatter`` — gradients leave reduce-scattered into the
+    same shard layout, which is exactly ZeRO's backward half."""
+
+    def g(leaf, spec):
+        for dim, ax in enumerate(spec):
+            if ax == axis:
+                return lax.all_gather(leaf, axis, axis=dim, tiled=True)
+        return leaf
+
+    return jax.tree.map(g, params, pspecs, is_leaf=lambda x: isinstance(x, P))
 
 
 def hybrid_loss_fn(
@@ -86,24 +110,34 @@ def make_hybrid_train_step(
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     pp_size = mesh.shape.get("pp", 1)
     pp_axis = "pp" if pp_size > 1 else None
+    fsdp_size = mesh.shape.get("fsdp", 1)
     if schedule == "1f1b" and not pp_axis:
         # silent fallback would let a user "measure 1F1B" on a pipeline-less
         # mesh and actually measure the gpipe path
         raise ValueError("schedule='1f1b' requires a mesh with pp > 1")
     if schedule == "1f1b" and getattr(model.config, "pp_interleave", 1) > 1:
         raise ValueError("pp_interleave > 1 composes with the gpipe schedule only")
-    pspecs = model.param_specs(pp=bool(pp_axis))
-    batch_spec = P("dp", "sp")
+    if schedule == "1f1b" and fsdp_size > 1:
+        # 1F1B differentiates per-tick INSIDE shard_map; composing the
+        # gather/reduce-scatter with that seed arithmetic is unbuilt — fail
+        # loudly rather than train on silently-replicated params
+        raise ValueError("fsdp > 1 composes with the gpipe schedule only")
+    pspecs = model.param_specs(pp=bool(pp_axis), fsdp=fsdp_size)
+    # fsdp doubles as a data axis (ZeRO): batch rows shard over dp × fsdp
+    batch_spec = P(("dp", "fsdp"), "sp")
     loss_fn = hybrid_loss_fn(model, attn_impl, pp_axis, n_microbatches)
     # value= lets loss-reactive transforms (utils.schedules.adaptive_plateau)
     # see the loss; the wrapper makes every optimizer accept it
     optimizer = optax.with_extra_args_support(optimizer)
 
     def total_loss(params, x, y):
+        # JIT weight reconstruction from ZeRO shards; the transpose of the
+        # gathers reduce-scatters the gradients back into shard layout
+        params = gather_fsdp(params, pspecs)
         # pmean over the batch axes so the per-rank value is the GLOBAL mean
         # loss, replicated on every rank (tp ranks agree by construction of
         # the vocab-sharded CE; pp ranks via the masked-head psum).
-        return lax.pmean(loss_fn(params, x, y), ("dp", "sp"))
+        return lax.pmean(loss_fn(params, x, y), ("dp", "fsdp", "sp"))
 
     sharded_loss = jax.shard_map(
         total_loss,
@@ -135,6 +169,9 @@ def make_hybrid_train_step(
         loss, grads = model.train_grads_1f1b_spmd(
             params, x, y, tp_axis="tp", sp_axis="sp", attn_impl=attn_impl,
             pp_axis="pp", n_micro=n_microbatches,
+            # the batch enters P(('dp','fsdp'),'sp'): data varies over fsdp
+            # too (size 1 on 1F1B meshes, but vma tracking still sees it)
+            batch_axes=("dp", "fsdp", "sp"),
         )
         # loss is masked to the last pp rank; batch axes hold genuinely
         # different values (mean them); remaining marked axes (tp) hold
@@ -187,9 +224,10 @@ def make_hybrid_train_step(
 def init_hybrid(model, optimizer, mesh: Mesh, seed: int = 0):
     """Initialize (params, opt_state) already placed on the mesh. With
     pp > 1 the layer list is stacked (leading layer axis) and stage-sharded
-    over 'pp'."""
+    over 'pp'; with fsdp > 1 leaves are ZeRO-sharded over 'fsdp'."""
     params = model.init(seed)
     pp = mesh.shape.get("pp", 1) > 1
+    fsdp_size = mesh.shape.get("fsdp", 1)
     if pp:
         from dsml_tpu.parallel.pp import interleave_layer_order, stack_layer_params
 
@@ -206,7 +244,7 @@ def init_hybrid(model, optimizer, mesh: Mesh, seed: int = 0):
             order = interleave_layer_order(n_layer, pp_size, v)
             layers = [layers[i] for i in order]
         params = {**params, "layers": stack_layer_params(layers)}
-    params = shard_params(params, mesh, model.param_specs(pp=pp))
+    params = shard_params(params, mesh, model.param_specs(pp=pp, fsdp=fsdp_size))
     opt_state = jax.jit(optimizer.init)(params)
 
     # leaves jit creates from scratch (adam's step count) come back on a

@@ -84,6 +84,111 @@ def test_fsdp_gpt2_trains_sharded(devices8):
     }
 
 
+def test_hybrid_fsdp_matches_pure_dp(devices8):
+    """FSDP inside the HYBRID (shard_map) step: every fsdp × dp/tp/sp mesh
+    shape reproduces the pure-DP loss trajectory while holding params
+    genuinely sharded — the gather-JIT / reduce-scatter-transpose path
+    (VERDICT r2 item 2)."""
+    import optax
+
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.parallel.hybrid import init_hybrid, make_hybrid_train_step
+
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    opt = optax.adam(1e-2)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, cfg.vocab_size, (8, cfg.max_seq)).astype(np.int32)
+    y = np.roll(x, -1, 1).astype(np.int32)
+
+    def run(spec, **kw):
+        mesh = build_mesh(spec, devices8)
+        step = make_hybrid_train_step(model, opt, mesh, attn_impl="ring", **kw)
+        params, ostate = init_hybrid(model, opt, mesh, seed=0)
+        out = []
+        for _ in range(4):
+            params, ostate, loss = step(params, ostate, x, y)
+            out.append(float(loss))
+        return out, params
+
+    ref, _ = run(MeshSpec(dp=8))
+    got, params = run(MeshSpec(dp=2, fsdp=4))
+    np.testing.assert_allclose(got, ref, rtol=2e-3)
+    # params really live 4-way sharded under the hybrid step too
+    w = params["layers"][0]["attn"]["wqkv"]
+    assert w.addressable_shards[0].data.size * 4 == w.size
+    got, _ = run(MeshSpec(dp=1, fsdp=2, sp=2, tp=2))
+    np.testing.assert_allclose(got, ref, rtol=2e-3)
+
+
+def test_hybrid_fsdp_composes_with_pipeline_gpipe(devices8):
+    """pp × fsdp × tp in one step (gpipe): the full five-axis composition —
+    and the 1F1B schedule refuses fsdp > 1 loudly instead of silently
+    replicating."""
+    import optax
+    import pytest
+
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.parallel.hybrid import init_hybrid, make_hybrid_train_step
+
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    opt = optax.adam(1e-2)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, cfg.vocab_size, (8, cfg.max_seq)).astype(np.int32)
+    y = np.roll(x, -1, 1).astype(np.int32)
+
+    mesh_dp = build_mesh(MeshSpec(dp=8), devices8)
+    step = make_hybrid_train_step(model, opt, mesh_dp, attn_impl="ring")
+    params, ostate = init_hybrid(model, opt, mesh_dp, seed=0)
+    ref = []
+    for _ in range(3):
+        params, ostate, loss = step(params, ostate, x, y)
+        ref.append(float(loss))
+
+    mesh = build_mesh(MeshSpec(pp=2, dp=1, fsdp=2, sp=1, tp=2), devices8)
+    step = make_hybrid_train_step(model, opt, mesh, attn_impl="ring", n_microbatches=2)
+    params, ostate = init_hybrid(model, opt, mesh, seed=0)
+    got = []
+    for _ in range(3):
+        params, ostate, loss = step(params, ostate, x, y)
+        got.append(float(loss))
+    np.testing.assert_allclose(got, ref, rtol=2e-3)
+
+    with pytest.raises(ValueError, match="fsdp > 1"):
+        make_hybrid_train_step(model, opt, mesh, schedule="1f1b", n_microbatches=2)
+
+
+def test_fsdp_llama_hybrid_matches_pure_dp(devices8):
+    """with_fsdp specs are model-generic: Llama under the hybrid step at
+    fsdp×tp matches its pure-DP trajectory."""
+    import optax
+
+    from dsml_tpu.models.llama import Llama, LlamaConfig
+    from dsml_tpu.parallel.hybrid import init_hybrid, make_hybrid_train_step
+
+    model = Llama(LlamaConfig.tiny())
+    cfg = model.config
+    opt = optax.adam(1e-2)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, cfg.vocab_size, (8, cfg.max_seq)).astype(np.int32)
+    y = np.roll(x, -1, 1).astype(np.int32)
+
+    def run(spec):
+        mesh = build_mesh(spec, devices8)
+        step = make_hybrid_train_step(model, opt, mesh, attn_impl="ring")
+        params, ostate = init_hybrid(model, opt, mesh, seed=0)
+        out = []
+        for _ in range(3):
+            params, ostate, loss = step(params, ostate, x, y)
+            out.append(float(loss))
+        return out
+
+    np.testing.assert_allclose(
+        run(MeshSpec(dp=2, fsdp=2, tp=2)), run(MeshSpec(dp=8)), rtol=2e-3
+    )
+
+
 def test_fsdp_llama_trains_sharded(devices8):
     """FSDP is model-generic: the Llama family trains with ZeRO-style
     sharding-annotated params (loss uses the plain single-device math;
