@@ -40,7 +40,10 @@ class GPT2TrainConfig(Config):
     family: str = field("gpt2", help="model family: gpt2 | llama (RMSNorm/RoPE/SwiGLU/GQA)")
     dtype: str = field("", help="params/activations dtype: float32 | bfloat16 ('' = model default; bfloat16 feeds the MXU at full rate on TPU)")
     remat: bool = field(False, help="rematerialize each block's activations in backward (less HBM, more FLOPs)")
-    data: str = field("", help="UTF-8 text file to train on ('' = generated stories)")
+    data: str = field(
+        "", help="UTF-8 text file to train on; 'prose' = real on-disk English "
+        "corpus (utils.data.load_text_corpus); '' = generated stories"
+    )
     steps: int = field(50, help="optimizer steps")
     batch_size: int = field(8, help="GLOBAL batch size (rows per optimizer step)")
     seq_len: int = field(0, help="sequence length (0 = model max)")
@@ -137,8 +140,16 @@ def main(argv=None):
     model = type(model)(model_cfg)
     seq = cfg.seq_len or model_cfg.max_seq
 
-    # ---- tokens: file or generated corpus, byte-level --------------------------
-    if cfg.data and os.path.exists(cfg.data):
+    # ---- tokens: file, real prose, or generated corpus — byte-level ------------
+    if cfg.data == "prose":
+        # REAL English text assembled from on-disk sources
+        # (utils.data.load_text_corpus): the loss-goes-down-on-real-text run
+        from dsml_tpu.utils.data import load_text_corpus
+
+        toks8, prov = load_text_corpus()
+        corpus = bytes(toks8)
+        log.info("training on real prose: %s (%d bytes)", prov, len(corpus))
+    elif cfg.data and os.path.exists(cfg.data):
         with open(cfg.data, "rb") as f:
             corpus = f.read()
         log.info("training on %s (%d bytes)", cfg.data, len(corpus))
