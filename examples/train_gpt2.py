@@ -149,7 +149,15 @@ def main(argv=None):
         toks8, prov = load_text_corpus()
         corpus = bytes(toks8)
         log.info("training on real prose: %s (%d bytes)", prov, len(corpus))
-    elif cfg.data and os.path.exists(cfg.data):
+    elif cfg.data:
+        # an explicit path that doesn't exist must raise — a typo must not
+        # silently train on the generated-stories fallback (same contract
+        # as utils.data.load_text_corpus)
+        if not os.path.exists(cfg.data):
+            raise FileNotFoundError(
+                f"--data {cfg.data!r} does not exist (use 'prose' for the "
+                "built-in real-text corpus, or '' for generated stories)"
+            )
         with open(cfg.data, "rb") as f:
             corpus = f.read()
         log.info("training on %s (%d bytes)", cfg.data, len(corpus))

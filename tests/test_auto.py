@@ -103,6 +103,34 @@ def test_measured_act_bytes_drives_sp():
     assert any("caller-measured" in r for r in plan.reasons)
 
 
+def test_measured_activation_bytes_compiles_and_scales():
+    """measured_activation_bytes reads XLA's own temp-buffer accounting
+    (compile-only, ShapeDtypeStructs in) — and a 4x bigger batch measures a
+    bigger footprint, which a constant-guess estimator can't do."""
+    import jax
+    import jax.numpy as jnp
+
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.parallel.auto import measured_activation_bytes
+
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    shapes = jax.eval_shape(lambda: model.init(0))
+
+    def args(batch):
+        x = jax.ShapeDtypeStruct((batch, cfg.max_seq), jnp.int32)
+        return shapes, x, x
+
+    small = measured_activation_bytes(model.loss, *args(2))
+    big = measured_activation_bytes(model.loss, *args(8))
+    assert small is not None and big is not None
+    assert big > small * 2, (small, big)
+    # and it drops into the planner as a real input
+    plan = plan_mesh(n_devices=8, n_params=1e5, n_head=cfg.n_head,
+                     act_bytes=big, hbm_bytes=16e9)
+    assert any("caller-measured" in r for r in plan.reasons)
+
+
 def test_planned_mesh_trains_end_to_end(devices8):
     """The plan is not advisory prose: build the mesh it returns and run a
     hybrid train step on it."""
@@ -210,6 +238,7 @@ def test_planner_emitted_fsdp_tp_mesh_trains(devices8):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_planner_emitted_pipeline_trains_gpipe_and_1f1b(devices8):
     """VERDICT r2 item 3 done-criterion: a deep model whose plan carries
     pp > 1 trains on the planned mesh with BOTH pipeline schedules."""

@@ -341,6 +341,7 @@ def test_lm_learns_real_text():
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.8, (losses[:5], losses[-5:])
 
 
+@pytest.mark.slow
 def test_gpt2_example_resume_on_mesh(tmp_path):
     """Multi-device checkpoint resume through the hybrid path: save on the
     8-device mesh, restore, and train on — pins the sharding-consistency fix

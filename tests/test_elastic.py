@@ -219,6 +219,7 @@ def test_awkward_survivor_count_idles_devices(devices8):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_elastic_restack_for_new_pipeline(devices8, monkeypatch):
     """Future-proofing pin: when the (here: forced) plan KEEPS a pipeline,
     reconfigure must restack the layers for the new stage count — including
@@ -310,6 +311,7 @@ def test_elastic_is_model_generic_llama(devices8):
     assert np.isfinite(float(l1)) and float(l1) < float(l0) + 0.5
 
 
+@pytest.mark.slow
 def test_torn_state_checkpoint_fallback_end_to_end(devices8, tmp_path):
     """The full Varuna-style fallback the refusal message points at: a
     pipeline loses an entire stage (state genuinely torn), reconfigure

@@ -5,6 +5,7 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "examples"))
@@ -68,6 +69,7 @@ def test_llama_family_example_trains():
     assert result["last_loss"] < result["first_loss"]
 
 
+@pytest.mark.slow
 def test_elastic_example_survives_device_loss():
     import train_elastic
 

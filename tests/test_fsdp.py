@@ -3,6 +3,7 @@ trajectory."""
 
 import jax
 import numpy as np
+import pytest
 import optax
 
 from dsml_tpu.models.mlp import MLP
@@ -159,6 +160,7 @@ def test_hybrid_fsdp_composes_with_pipeline_gpipe(devices8):
         make_hybrid_train_step(model, opt, mesh, schedule="1f1b", n_microbatches=2)
 
 
+@pytest.mark.slow
 def test_fsdp_llama_hybrid_matches_pure_dp(devices8):
     """with_fsdp specs are model-generic: Llama under the hybrid step at
     fsdp×tp matches its pure-DP trajectory."""

@@ -159,6 +159,7 @@ def test_tp_sharded_cache_is_head_sharded(model_and_params):
     assert cache[0]["k"].shape[1] == model.config.n_head // 4
 
 
+@pytest.mark.slow
 def test_generate_spmd_dp_sharded_matches_unsharded(devices8):
     """Throughput serving: the batch sharded over dp — greedy tokens equal
     the unsharded run row-for-row, and sampled runs are row-decomposable

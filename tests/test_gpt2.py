@@ -190,6 +190,7 @@ def test_moe_gradients_match_single_device(devices8):
     )
 
 
+@pytest.mark.slow
 def test_moe_training_converges(hybrid_mesh):
     cfg = GPT2Config.tiny(n_experts=4)
     model = GPT2(cfg)
@@ -325,6 +326,7 @@ def test_pp_hybrid_loss_and_grads_match_single_device(pp_mesh8):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=2e-3, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pp_interleaved_hybrid_matches_single_device(pp_mesh8):
     """Interleaved virtual stages (pp_interleave=2, 4 layers over 2 ranks as
     round-robin chunks): loss and a full train step stay exact vs the plain
@@ -525,6 +527,7 @@ def test_tp_requires_divisible_heads(devices8):
         )(model.init(0), np.zeros((8, 64), np.int32))
 
 
+@pytest.mark.slow
 def test_interleaved_pipeline_with_int8_remat(pp_mesh8):
     """Composition pin: interleaved virtual stages AND compressed int8 remat
     in one step — the chunk-level compressed_checkpoint rides inside the

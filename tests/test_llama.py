@@ -114,6 +114,7 @@ def test_hybrid_train_step_converges(model, hybrid_mesh):
     assert losses[-1] < losses[0] and all(np.isfinite(losses))
 
 
+@pytest.mark.slow
 def test_pipeline_hybrid_matches_single_device(devices8):
     """pp=2 GPipe pipeline over the Llama stack (4 layers, stacked+sharded):
     loss equals single device — the pipeline machinery is model-generic."""
@@ -130,6 +131,7 @@ def test_pipeline_hybrid_matches_single_device(devices8):
     np.testing.assert_allclose(float(loss), expected, rtol=5e-4)
 
 
+@pytest.mark.slow
 def test_1f1b_schedule_works(devices8):
     cfg = dataclasses.replace(LlamaConfig.tiny(), n_layer=4)
     model = Llama(cfg)
@@ -193,6 +195,7 @@ def test_gqa_cache_is_kv_heads_only(model):
     assert cache[0]["k"].shape == (2, cfg.n_kv_head // 2, cfg.max_seq, hd)
 
 
+@pytest.mark.slow
 def test_int8_remat_trains(model, hybrid_mesh):
     cfg = dataclasses.replace(model.config, remat="int8")
     m = Llama(cfg)
@@ -214,6 +217,7 @@ def test_preset_lookup():
         LlamaConfig.by_name("llama9")
 
 
+@pytest.mark.slow
 def test_moe_llama_hybrid_matches_single_device(devices8):
     """Mixtral-style Llama (tiny: 4 experts, top-2) through the full hybrid step:
     sharded loss equals single device — GQA+RoPE trunk with the inherited

@@ -1,6 +1,7 @@
 """CNN and ResNet-18 model families train data-parallel (BASELINE configs)."""
 
 import numpy as np
+import pytest
 
 from dsml_tpu.models.cnn import CNN
 from dsml_tpu.models.resnet import ResNet18
@@ -39,6 +40,7 @@ def test_resnet18_structure():
     assert logits.shape == (2, 10)
 
 
+@pytest.mark.slow
 def test_resnet18_trains_dp(dp_mesh8):
     data = synthetic_classification(256, features=32 * 32 * 3, classes=10, seed=1,
                                     image_shape=(32, 32, 3))

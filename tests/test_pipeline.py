@@ -218,6 +218,7 @@ def test_1f1b_grads_match_single_device(devices8):
         assert np.max(np.abs(g - r)) <= 1e-4 * (np.max(np.abs(r)) + 1e-8)
 
 
+@pytest.mark.slow
 def test_1f1b_converges_with_moe(devices8):
     """1F1B × expert-parallel MoE (all_to_all inside the per-tick vjp)."""
     import optax
@@ -242,6 +243,7 @@ def test_1f1b_converges_with_moe(devices8):
     assert last < first - 0.2, (first, last)
 
 
+@pytest.mark.slow
 def test_1f1b_activation_memory_flat_in_microbatches(devices8):
     """The schedule's reason to exist: GPipe-via-jax.grad stores one
     residual set per tick (activation memory grows with M), 1F1B bounds
