@@ -850,14 +850,30 @@ class GPT2:
 
     def _decode_attention(self, q, ck, cv, valid):
         """q [b, H, 1, hd] against the full cache [b, Hc, S, hd] (H == Hc
-        here; Llama overrides with the grouped-query form)."""
+        here; Llama overrides with the grouped-query form). ``valid`` is
+        [S] (shared depth) or [b, S] (per-slot depth, continuous
+        batching)."""
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck) * (q.shape[-1] ** -0.5)
-        scores = jnp.where(valid[None, None, None, :], scores, _NEG_INF)
+        vmask = valid[None, None, None, :] if valid.ndim == 1 else valid[:, None, None, :]
+        scores = jnp.where(vmask, scores, _NEG_INF)
         return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), cv)
 
-    def prefill(self, params: dict, tokens: jax.Array, tp_axis: str | None = None):
+    def prefill(
+        self,
+        params: dict,
+        tokens: jax.Array,
+        tp_axis: str | None = None,
+        last_index=None,
+    ):
         """Run the prompt [batch, T] in ONE pass, filling the cache.
         Returns (last-position logits [batch, vocab], cache).
+
+        ``last_index`` (static or traced int) reads the logits at that
+        position instead of T-1 — the bucketed-prefill hook: a prompt of
+        true length L right-padded to a compiled bucket length passes
+        ``last_index=L-1`` (causality keeps positions < L pad-free; pad
+        rows land in the cache beyond L but the decode mask never admits
+        them before they're overwritten).
 
         With ``tp_axis`` (call under shard_map with Megatron-sharded
         params), the pass is head-parallel: local-head attention + one psum
@@ -892,7 +908,13 @@ class GPT2:
                 "v": lax.dynamic_update_slice(cache[i]["v"], vc, (0, 0, 0, 0)),
             }
         h = self._final_norm(params, h)
-        return self._unembed_full(params, h[:, -1], tp_axis), cache
+        if last_index is None:
+            h_last = h[:, -1]
+        else:
+            h_last = lax.dynamic_index_in_dim(
+                h, jnp.asarray(last_index, jnp.int32), axis=1, keepdims=False
+            )
+        return self._unembed_full(params, h_last, tp_axis), cache
 
     def decode_step(
         self, params: dict, cache: list, tokens: jax.Array, pos: jax.Array,
@@ -911,6 +933,41 @@ class GPT2:
             q, kc, vc, _, _ = self._serving_qkv(layer, x, positions, tp_size)
             ck = lax.dynamic_update_slice(c["k"], kc, (0, 0, pos, 0))
             cv = lax.dynamic_update_slice(c["v"], vc, (0, 0, pos, 0))
+            out = self._decode_attention(q, ck, cv, valid)
+            attn_out = self._merge_heads(out) @ layer["attn"]["wo"]
+            if tp_axis:
+                attn_out = lax.psum(attn_out, tp_axis)
+            h = h + attn_out + self._attn_out_bias(layer)
+            h = self._ffn(layer, h, tp_axis)
+            new_cache.append({"k": ck, "v": cv})
+        h = self._final_norm(params, h)
+        return self._unembed_full(params, h[:, 0], tp_axis), new_cache
+
+    def decode_step_slots(
+        self, params: dict, cache: list, tokens: jax.Array, pos: jax.Array,
+        tp_axis: str | None = None,
+    ):
+        """One decode step with PER-SLOT positions — the continuous-batching
+        kernel (``dsml_tpu.serving``): ``tokens`` [batch] are each slot's
+        last token, ``pos`` [batch] each slot's own depth. Shapes are fully
+        static; per-slot cache writes are a batched scatter at
+        ``(b, :, pos[b], :)`` and the attention mask admits ``s <= pos[b]``
+        per row, so slots at different depths decode in ONE program.
+        Returns (logits [batch, vocab], updated cache)."""
+        cfg = self.config
+        b = tokens.shape[0]
+        tp_size = lax.axis_size(tp_axis) if tp_axis else 1
+        pos = jnp.asarray(pos, jnp.int32)
+        positions = pos[:, None]  # [b, 1]: per-row position of the 1 new token
+        h = self._embed_spmd(params, tokens[:, None], tp_axis, seq_offset=positions)
+        valid = jnp.arange(cfg.max_seq)[None, :] <= pos[:, None]  # [b, S]
+        bidx = jnp.arange(b)
+        new_cache = []
+        for layer, c in zip(params["layers"], cache):
+            x = self._norm1(layer, h)
+            q, kc, vc, _, _ = self._serving_qkv(layer, x, positions, tp_size)
+            ck = c["k"].at[bidx, :, pos, :].set(kc[:, :, 0, :])
+            cv = c["v"].at[bidx, :, pos, :].set(vc[:, :, 0, :])
             out = self._decode_attention(q, ck, cv, valid)
             attn_out = self._merge_heads(out) @ layer["attn"]["wo"]
             if tp_axis:

@@ -123,13 +123,19 @@ def _rms_norm(x, scale, eps=1e-5):
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     """Rotary embedding, rotate-half convention. ``x`` [b, h, s, hd],
-    ``positions`` [s] GLOBAL token positions (int32)."""
+    ``positions`` [s] GLOBAL token positions (int32) shared across the
+    batch, or [b, s] per-row positions (continuous-batching decode, where
+    every slot sits at its own depth)."""
     hd = x.shape[-1]
     half = hd // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) * 2.0 / hd)  # [half]
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [s, half]
-    cos = jnp.cos(angles)[None, None, :, :]
-    sin = jnp.sin(angles)[None, None, :, :]
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [(b,) s, half]
+    if angles.ndim == 2:  # shared positions → broadcast over batch and heads
+        cos = jnp.cos(angles)[None, None, :, :]
+        sin = jnp.sin(angles)[None, None, :, :]
+    else:  # per-row positions → broadcast over heads only
+        cos = jnp.cos(angles)[:, None, :, :]
+        sin = jnp.sin(angles)[:, None, :, :]
     x32 = x.astype(jnp.float32)
     x1, x2 = x32[..., :half], x32[..., half:]
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
@@ -365,14 +371,16 @@ class Llama(GPT2):
         """Grouped-query attention against the kv-head cache — query heads
         grouped over their kv head, no materialized repeat; scores
         accumulate f32 via preferred_element_type (no full-cache upcast
-        copies on the decode hot path)."""
+        copies on the decode hot path). ``valid`` is [S] (shared depth) or
+        [b, S] (per-slot depth, continuous batching)."""
         b, hq, s, hd = q.shape
         repeat = hq // ck.shape[1]
         qg = q.reshape(b, hq // repeat, repeat, s, hd)
         scores = jnp.einsum(
             "bgrqd,bgkd->bgrqk", qg, ck, preferred_element_type=jnp.float32
         ) * (hd ** -0.5)
-        scores = jnp.where(valid[None, None, None, None, :], scores, _NEG_INF)
+        vmask = valid[None, None, None, None, :] if valid.ndim == 1 else valid[:, None, None, None, :]
+        scores = jnp.where(vmask, scores, _NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
         # bf16 inputs feed the MXU at full rate; f32 accumulation keeps the
         # long-context value sum from drifting (same precision as the scores)

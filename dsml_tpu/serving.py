@@ -1,0 +1,248 @@
+"""Continuous-batching serving — slot-based decode with in-flight admission.
+
+The reference has no inference path at all (SURVEY.md §5; its client only
+trains, ``client.go:516-659``); the framework's serving stack already does
+static batched decode (``GPT2.generate``/``generate_spmd``). This module
+adds the throughput layer a real serving deployment needs: requests arrive
+at different times with different prompt/output lengths, and a static
+batch would idle every slot until the LONGEST request finishes. Continuous
+batching (the vLLM/Orca scheduling idea) retires each request the moment
+it completes and admits a queued one into the freed slot — realized here
+TPU-first:
+
+- ONE jitted decode program for all slots (``model.decode_step_slots``):
+  fully static shapes, per-slot depths carried as a ``pos`` vector, cache
+  writes as a batched scatter, attention masked to ``s <= pos[b]`` per
+  row. No recompilation ever happens at steady state.
+- Prefill compiles once per PROMPT BUCKET (next power-of-two length):
+  prompts are right-padded to the bucket, the logits read at the true
+  last index (``prefill(last_index=L-1)``), and the new request's cache
+  rows are scattered into its slot.
+- The host-side scheduler is a plain loop: admit → decode → emit/retire.
+  Sampling is greedy or temperature-based with a per-request key, so a
+  request's tokens are independent of which slot/step served it.
+
+Single-device by design (the TP/DP-sharded decode lives in
+``generate_spmd``); slots × continuous admission is the axis this module
+adds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Request", "ContinuousBatcher"]
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray  # [L] int32
+    max_new_tokens: int
+    tokens: list = dataclasses.field(default_factory=list)  # emitted so far
+    done: bool = False
+
+
+def _bucket(n: int, buckets: tuple) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds the largest bucket {buckets[-1]}")
+
+
+class ContinuousBatcher:
+    """Slot-based continuous-batching decoder over one model + params.
+
+    ``submit`` enqueues prompts; ``step`` admits queued requests into free
+    slots (bucketed prefill), runs ONE slot-decode step, emits new tokens,
+    and retires finished requests (EOS or token budget). ``run`` drains
+    everything. Greedy by default; ``temperature > 0`` samples with a
+    per-request fold of ``seed`` so results don't depend on slot timing.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        n_slots: int = 8,
+        eos_id: int | None = None,
+        temperature: float = 0.0,
+        seed: int = 0,
+        prompt_buckets: tuple = (32, 64, 128, 256, 512, 1024),
+    ):
+        cfg = model.config
+        self.model = model
+        self.params = params
+        self.n_slots = n_slots
+        self.eos_id = eos_id
+        self.temperature = float(temperature)
+        self.seed = seed
+        self.prompt_buckets = tuple(b for b in prompt_buckets if b <= cfg.max_seq)
+        if not self.prompt_buckets:
+            raise ValueError(f"no prompt bucket fits max_seq={cfg.max_seq}")
+
+        self._queue: deque[Request] = deque()
+        self._live: dict[int, Request] = {}  # queued or in a slot
+        self._done: dict[int, Request] = {}  # retired, awaiting collect()
+        self._next_rid = 0
+        # slot state (host-side numpy; device state is the cache)
+        self._slot_rid = np.full(n_slots, -1, np.int64)  # -1 = free
+        self._pos = np.zeros(n_slots, np.int32)  # next cache write index
+        self._last_tok = np.zeros(n_slots, np.int32)
+        self._cache = model.init_cache(n_slots)
+
+        # the cache is donated: XLA updates it in place each step instead of
+        # allocating + copying the full [slots, H, max_seq, hd] buffers per
+        # token (params are NOT donated — they serve every step)
+        self._decode = jax.jit(
+            lambda p, c, t, pos: model.decode_step_slots(p, c, t, pos),
+            donate_argnums=(1,),
+        )
+        # one prefill compile per bucket length (static last_index would
+        # recompile per prompt length — keep it traced)
+        self._prefill = jax.jit(
+            lambda p, toks, last: model.prefill(p, toks, last_index=last)
+        )
+        self._insert = jax.jit(self._insert_fn, donate_argnums=(0,))
+
+    @staticmethod
+    def _insert_fn(cache, cache1, slot):
+        """Scatter a 1-row prefill cache into slot ``slot`` of the big
+        cache (the admission write)."""
+        return [
+            {
+                "k": c["k"].at[slot].set(c1["k"][0]),
+                "v": c["v"].at[slot].set(c1["v"][0]),
+            }
+            for c, c1 in zip(cache, cache1)
+        ]
+
+    # ---- request interface -----------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        cfg = self.model.config
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_new_tokens > cfg.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_seq={cfg.max_seq}"
+            )
+        _bucket(len(prompt), self.prompt_buckets)  # reject at submit, not admit
+        if max_new_tokens < 1:
+            # generate raises for this too — the serving path must not
+            # silently emit a token for a zero-budget request
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens)
+        self._queue.append(req)
+        self._live[rid] = req
+        return rid
+
+    @property
+    def n_active(self) -> int:
+        return int((self._slot_rid >= 0).sum())
+
+    @property
+    def n_queued(self) -> int:
+        return len(self._queue)
+
+    # ---- scheduling ------------------------------------------------------------
+
+    def _sample(self, logits: np.ndarray, req: Request) -> int:
+        if self.temperature <= 0.0:
+            return int(np.argmax(logits))
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), req.rid)
+        key = jax.random.fold_in(key, len(req.tokens))
+        scaled = jnp.asarray(logits, jnp.float32) / self.temperature
+        return int(jax.random.categorical(key, scaled))
+
+    def _admit(self) -> None:
+        """Fill free slots from the queue: bucketed prefill + cache insert +
+        first sampled token. A request that finishes AT prefill (budget 1 or
+        immediate EOS) never occupies the slot, so the same slot admits the
+        next queued request within this pass."""
+        for slot in np.flatnonzero(self._slot_rid < 0):
+            while self._queue and self._slot_rid[slot] < 0:
+                req = self._queue.popleft()
+                L = len(req.prompt)
+                bucket = _bucket(L, self.prompt_buckets)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :L] = req.prompt
+                logits, cache1 = self._prefill(
+                    self.params, jnp.asarray(padded), jnp.int32(L - 1)
+                )
+                self._cache = self._insert(self._cache, cache1, int(slot))
+                tok = self._sample(np.asarray(logits[0]), req)
+                req.tokens.append(tok)
+                if self._finished(req, tok):
+                    self._retire(req)  # slot still free: while-loop admits next
+                    continue
+                self._slot_rid[slot] = req.rid
+                self._pos[slot] = L
+                self._last_tok[slot] = tok
+
+    def _finished(self, req: Request, tok: int) -> bool:
+        return (self.eos_id is not None and tok == self.eos_id) or (
+            len(req.tokens) >= req.max_new_tokens
+        )
+
+    def _retire(self, req: Request) -> None:
+        req.done = True
+        # move out of the live table so a long-running server doesn't
+        # accumulate one Request per lifetime request; collect() drains
+        self._done[req.rid] = self._live.pop(req.rid)
+
+    def step(self) -> dict[int, int]:
+        """One scheduler tick: admit, one decode step over ALL slots, emit.
+        Returns {rid: new token} for every active request this tick."""
+        self._admit()
+        active = np.flatnonzero(self._slot_rid >= 0)
+        if len(active) == 0:
+            return {}
+        logits, self._cache = self._decode(
+            self.params,
+            self._cache,
+            jnp.asarray(self._last_tok),
+            jnp.asarray(self._pos),
+        )
+        logits = np.asarray(logits)
+        emitted: dict[int, int] = {}
+        for slot in active:
+            req = self._live[int(self._slot_rid[slot])]
+            tok = self._sample(logits[slot], req)
+            req.tokens.append(tok)
+            emitted[req.rid] = tok
+            self._pos[slot] += 1
+            self._last_tok[slot] = tok
+            if self._finished(req, tok):
+                self._retire(req)
+                self._slot_rid[slot] = -1  # slot freed → next admit reuses it
+        return emitted
+
+    def collect(self) -> dict[int, list]:
+        """{rid: [tokens]} for every request retired since the last collect
+        (drained — repeated calls don't re-report, and the batcher holds no
+        per-request state afterwards)."""
+        done = {rid: req.tokens for rid, req in self._done.items()}
+        self._done.clear()
+        return done
+
+    def run(self, max_steps: int = 100_000) -> dict[int, list]:
+        """Drain queue + slots; returns {rid: [tokens]} for every request
+        retired during (or before) this call."""
+        for _ in range(max_steps):
+            if not self._queue and self.n_active == 0:
+                break
+            self.step()
+        else:
+            raise RuntimeError(f"serving did not drain within {max_steps} steps")
+        return self.collect()

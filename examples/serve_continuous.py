@@ -1,0 +1,117 @@
+"""Continuous-batching serving demo: mixed-length requests through
+``dsml_tpu.serving.ContinuousBatcher`` vs the static-batch baseline.
+
+The reference has no inference path (SURVEY.md §5; its client only trains);
+the framework's ``generate`` already does batched decode. This example shows
+the scheduling layer on top: requests with different prompt/output lengths
+are served slot-based — a finished request's slot is refilled from the
+queue immediately, where a static batch idles every lane until the longest
+request finishes. Prints per-strategy wall time and decode-lane utilization.
+
+Run (CPU): python examples/serve_continuous.py --platform cpu --requests 12
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")  # repo-root invocation
+
+from dsml_tpu.utils.config import Config, field
+from dsml_tpu.utils.logging import get_logger
+
+log = get_logger("serve")
+
+
+@dataclasses.dataclass
+class ServeConfig(Config):
+    platform: str = field("", help="jax platform override: cpu|tpu ('' = default)")
+    cpu_devices: int = field(0, help="virtual CPU device count for --platform cpu")
+    family: str = field("gpt2", help="model family: gpt2 | llama")
+    model: str = field("tiny", help="model preset (tiny for the demo)")
+    n_slots: int = field(4, help="decode slots (concurrent requests)")
+    requests: int = field(12, help="number of requests in the workload")
+    max_new_max: int = field(24, help="largest per-request token budget")
+    temperature: float = field(0.0, help="0 = greedy")
+    seed: int = field(0, help="workload seed")
+
+
+def main() -> None:
+    cfg = ServeConfig.parse_args()
+    if cfg.platform:
+        from dsml_tpu.utils.platform import configure_platform
+
+        configure_platform(cfg.platform, cfg.cpu_devices or None)
+
+    from dsml_tpu.models import model_by_family
+    from dsml_tpu.serving import ContinuousBatcher
+
+    model, mcfg = model_by_family(cfg.family, cfg.model)
+    params = model.init(cfg.seed)
+
+    rng = np.random.default_rng(cfg.seed)
+    lengths = rng.integers(4, min(64, mcfg.max_seq // 2), cfg.requests)
+    budgets = rng.integers(2, cfg.max_new_max + 1, cfg.requests)
+    prompts = [rng.integers(0, mcfg.vocab_size, (l,)).astype(np.int32) for l in lengths]
+    total_tokens = int(budgets.sum())
+    log.info(
+        "workload: %d requests, prompts %d-%d tokens, budgets %d-%d, %d total new tokens",
+        cfg.requests, lengths.min(), lengths.max(), budgets.min(), budgets.max(),
+        total_tokens,
+    )
+
+    # ---- continuous batching ---------------------------------------------------
+    srv = ContinuousBatcher(
+        model, params, n_slots=cfg.n_slots, temperature=cfg.temperature,
+        seed=cfg.seed, prompt_buckets=(16, 32, 64),
+    )
+    rids = [srv.submit(p, int(n)) for p, n in zip(prompts, budgets)]
+    t0 = time.monotonic()
+    steps = 0
+    useful_ticks = 0  # decode-lane ticks that produced a wanted token
+    while srv.n_queued or srv.n_active:
+        useful_ticks += len(srv.step())
+        steps += 1
+    cont_s = time.monotonic() - t0
+
+    # ---- static-batch baseline: groups of n_slots, everyone waits for the
+    # group's longest budget (what a naive batched `generate` loop does) -----
+    t0 = time.monotonic()
+    static_useful = 0
+    static_ticks = 0
+    for i in range(0, cfg.requests, cfg.n_slots):
+        group = list(range(i, min(i + cfg.n_slots, cfg.requests)))
+        n_max = int(max(budgets[g] for g in group))
+        # decode ticks per lane = n_max - 1 (the first token comes from
+        # prefill, same as the batcher); wanted ticks per request likewise
+        static_useful += sum(int(budgets[g]) - 1 for g in group)
+        static_ticks += (n_max - 1) * cfg.n_slots
+        width = int(max(lengths[g] for g in group))
+        batch = np.zeros((len(group), width), np.int32)
+        for row, g in enumerate(group):
+            batch[row, width - lengths[g]:] = prompts[g]  # left-pad
+        model.generate(params, batch, n_max, temperature=0.0, seed=cfg.seed)
+    static_s = time.monotonic() - t0
+
+    util = useful_ticks / max(steps * cfg.n_slots, 1)
+    static_util = static_useful / max(static_ticks, 1)
+    log.info(
+        "continuous: %.2fs (%d scheduler steps, lane utilization %.0f%%)",
+        cont_s, steps, 100 * util,
+    )
+    log.info(
+        "static    : %.2fs (lane utilization %.0f%% — idle lanes wait for the "
+        "group's longest request)", static_s, 100 * static_util,
+    )
+    log.info(
+        "tokens/s: continuous %.1f vs static %.1f",
+        total_tokens / cont_s, total_tokens / static_s,
+    )
+
+
+if __name__ == "__main__":
+    main()
