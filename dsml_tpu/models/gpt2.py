@@ -69,6 +69,12 @@ class GPT2Config:
     # bubble by the same factor (parallel.pp.pipeline_apply_interleaved).
     # Requires n_layer divisible by pp×pp_interleave; gpipe schedule only
     pp_interleave: int = 1
+    # serving: store the KV cache int8 with a per-(b, h, position) scale —
+    # ~4x below the f32 cache / 2x below bf16 in both HBM footprint and
+    # decode read bandwidth (the cache read IS the decode bottleneck at
+    # long context). Dequantized at the attention boundary; prefill/decode/
+    # decode_step_slots and both model families share the one code path
+    kv_quant: bool = False
 
     @staticmethod
     def small() -> "GPT2Config":
@@ -769,20 +775,63 @@ class GPT2:
     def init_cache(self, batch: int, tp_size: int = 1) -> list:
         """KV cache, pre-allocated at max_seq. Under TP the cache holds only
         this rank's head shard — attention is head-parallel, so decode's
-        per-chip cache memory drops by tp (the point of sharded serving)."""
+        per-chip cache memory drops by tp (the point of sharded serving).
+        With ``config.kv_quant`` the entries are int8 + per-position scales
+        (see :meth:`_cache_write`)."""
         cfg = self.config
         if cfg.n_head % tp_size:
             raise ValueError(f"n_head={cfg.n_head} not divisible by tp={tp_size}")
-        hd = cfg.d_model // cfg.n_head
-        n_head_local = cfg.n_head // tp_size
-        dt = jnp.dtype(cfg.dtype)
         return [
-            {
-                "k": jnp.zeros((batch, n_head_local, cfg.max_seq, hd), dt),
-                "v": jnp.zeros((batch, n_head_local, cfg.max_seq, hd), dt),
-            }
+            self._cache_entry(batch, cfg.n_head // tp_size)
             for _ in range(cfg.n_layer)
         ]
+
+    def _cache_entry(self, batch: int, n_heads: int) -> dict:
+        cfg = self.config
+        hd = cfg.d_model // cfg.n_head
+        shape = (batch, n_heads, cfg.max_seq, hd)
+        if cfg.kv_quant:
+            return {
+                "k": jnp.zeros(shape, jnp.int8),
+                "k_s": jnp.zeros((*shape[:3], 1), jnp.float32),
+                "v": jnp.zeros(shape, jnp.int8),
+                "v_s": jnp.zeros((*shape[:3], 1), jnp.float32),
+            }
+        dt = jnp.dtype(cfg.dtype)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    @staticmethod
+    def _kv_quantize(x):
+        """[b, h, s, hd] → (int8 values, f32 scale [b, h, s, 1]): symmetric
+        absmax per position — each token's K/V row quantizes independently,
+        so cache writes never touch other rows' scales."""
+        a = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        s = jnp.where(a > 0, a / 127.0, 1.0)
+        return jnp.round(x.astype(jnp.float32) / s).astype(jnp.int8), s
+
+    def _cache_write(self, c: dict, kc, vc, write) -> dict:
+        """Write new K/V rows through ``write(cache_array, new_rows)`` —
+        the ONE place the quantized and plain layouts branch. ``write`` is
+        the caller's placement (full-prefix ``dynamic_update_slice``, shared
+        decode position, or the per-slot batched scatter); scale tensors ride
+        the same placement with their trailing dim of 1."""
+        if self.config.kv_quant:
+            kq, ks = self._kv_quantize(kc)
+            vq, vs = self._kv_quantize(vc)
+            return {"k": write(c["k"], kq), "k_s": write(c["k_s"], ks),
+                    "v": write(c["v"], vq), "v_s": write(c["v_s"], vs)}
+        return {"k": write(c["k"], kc), "v": write(c["v"], vc)}
+
+    def _cache_attn_inputs(self, c: dict):
+        """(ck, cv, k_s, v_s) for :meth:`_decode_attention` — scales are
+        None for the plain cache. The int8 values go INTO the attention
+        dots as-is (the int8→float convert feeds the dot operand, which XLA
+        fuses, instead of materializing a dequantized full-width cache
+        copy); the per-position scales, constant along ``hd``, fold in
+        AFTER each dot — mathematically identical to dequantize-then-dot."""
+        if self.config.kv_quant:
+            return c["k"], c["v"], c["k_s"], c["v_s"]
+        return c["k"], c["v"], None, None
 
     def _qkv_heads(self, layer, x, n_head_local: int | None = None):
         """Fused QKV projection + head split. ``layer['attn']['wqkv']`` is
@@ -848,15 +897,25 @@ class GPT2:
         q, k, v = self._qkv_heads(layer, x, self.config.n_head // tp_size)
         return q, k, v, k, v
 
-    def _decode_attention(self, q, ck, cv, valid):
+    def _decode_attention(self, q, ck, cv, valid, k_s=None, v_s=None):
         """q [b, H, 1, hd] against the full cache [b, Hc, S, hd] (H == Hc
         here; Llama overrides with the grouped-query form). ``valid`` is
-        [S] (shared depth) or [b, S] (per-slot depth, continuous
-        batching)."""
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck) * (q.shape[-1] ** -0.5)
+        [S] (shared depth) or [b, S] (per-slot depth, continuous batching).
+        ``k_s``/``v_s`` [b, Hc, S, 1] are the int8 cache's per-position
+        scales, folded in after each dot (see ``_cache_attn_inputs``)."""
+        if k_s is None:
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck) * (q.shape[-1] ** -0.5)
+            vmask = valid[None, None, None, :] if valid.ndim == 1 else valid[:, None, None, :]
+            scores = jnp.where(vmask, scores, _NEG_INF)
+            return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), cv)
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", q.astype(jnp.float32), ck.astype(jnp.float32)
+        ) * (q.shape[-1] ** -0.5)
+        scores = scores * jnp.swapaxes(k_s, -1, -2)  # fold key scales: [b, h, 1, S]
         vmask = valid[None, None, None, :] if valid.ndim == 1 else valid[:, None, None, :]
         scores = jnp.where(vmask, scores, _NEG_INF)
-        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), cv)
+        probs = jax.nn.softmax(scores, axis=-1) * jnp.swapaxes(v_s, -1, -2)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, cv.astype(jnp.float32)).astype(q.dtype)
 
     def prefill(
         self,
@@ -903,10 +962,12 @@ class GPT2:
                 attn_out = lax.psum(attn_out, tp_axis)
             h = h + attn_out + self._attn_out_bias(layer)
             h = self._ffn(layer, h, tp_axis)
-            cache[i] = {
-                "k": lax.dynamic_update_slice(cache[i]["k"], kc, (0, 0, 0, 0)),
-                "v": lax.dynamic_update_slice(cache[i]["v"], vc, (0, 0, 0, 0)),
-            }
+            cache[i] = self._cache_write(
+                cache[i], kc, vc,
+                lambda arr, new: lax.dynamic_update_slice(
+                    arr, new, (0,) * arr.ndim
+                ),
+            )
         h = self._final_norm(params, h)
         if last_index is None:
             h_last = h[:, -1]
@@ -916,6 +977,29 @@ class GPT2:
             )
         return self._unembed_full(params, h_last, tp_axis), cache
 
+    def _decode_core(self, params, cache, h, positions, valid, write, tp_axis):
+        """The shared decode layer loop: norm → qkv → cache write (via the
+        caller's ``write`` placement) → cached attention → wo/psum → ffn,
+        then final-norm + full-vocab unembed. ``decode_step`` (shared
+        scalar position) and ``decode_step_slots`` (per-slot position
+        vector) differ ONLY in positions/valid/write."""
+        tp_size = lax.axis_size(tp_axis) if tp_axis else 1
+        new_cache = []
+        for layer, c in zip(params["layers"], cache):
+            x = self._norm1(layer, h)
+            q, kc, vc, _, _ = self._serving_qkv(layer, x, positions, tp_size)
+            c = self._cache_write(c, kc, vc, write)
+            ck, cv, k_s, v_s = self._cache_attn_inputs(c)
+            out = self._decode_attention(q, ck, cv, valid, k_s, v_s)
+            attn_out = self._merge_heads(out) @ layer["attn"]["wo"]
+            if tp_axis:
+                attn_out = lax.psum(attn_out, tp_axis)
+            h = h + attn_out + self._attn_out_bias(layer)
+            h = self._ffn(layer, h, tp_axis)
+            new_cache.append(c)
+        h = self._final_norm(params, h)
+        return self._unembed_full(params, h[:, 0], tp_axis), new_cache
+
     def decode_step(
         self, params: dict, cache: list, tokens: jax.Array, pos: jax.Array,
         tp_axis: str | None = None,
@@ -923,25 +1007,14 @@ class GPT2:
         """One decode step: ``tokens`` [batch] at position ``pos`` (scalar,
         int or traced). Returns (logits [batch, vocab], updated cache)."""
         cfg = self.config
-        tp_size = lax.axis_size(tp_axis) if tp_axis else 1
         positions = jnp.reshape(jnp.asarray(pos, jnp.int32), (1,))
         h = self._embed_spmd(params, tokens[:, None], tp_axis, seq_offset=pos)
         valid = jnp.arange(cfg.max_seq) <= pos  # attend to cache[0..pos]
-        new_cache = []
-        for layer, c in zip(params["layers"], cache):
-            x = self._norm1(layer, h)
-            q, kc, vc, _, _ = self._serving_qkv(layer, x, positions, tp_size)
-            ck = lax.dynamic_update_slice(c["k"], kc, (0, 0, pos, 0))
-            cv = lax.dynamic_update_slice(c["v"], vc, (0, 0, pos, 0))
-            out = self._decode_attention(q, ck, cv, valid)
-            attn_out = self._merge_heads(out) @ layer["attn"]["wo"]
-            if tp_axis:
-                attn_out = lax.psum(attn_out, tp_axis)
-            h = h + attn_out + self._attn_out_bias(layer)
-            h = self._ffn(layer, h, tp_axis)
-            new_cache.append({"k": ck, "v": cv})
-        h = self._final_norm(params, h)
-        return self._unembed_full(params, h[:, 0], tp_axis), new_cache
+        return self._decode_core(
+            params, cache, h, positions, valid,
+            lambda arr, new: lax.dynamic_update_slice(arr, new, (0, 0, pos, 0)),
+            tp_axis,
+        )
 
     def decode_step_slots(
         self, params: dict, cache: list, tokens: jax.Array, pos: jax.Array,
@@ -956,27 +1029,16 @@ class GPT2:
         Returns (logits [batch, vocab], updated cache)."""
         cfg = self.config
         b = tokens.shape[0]
-        tp_size = lax.axis_size(tp_axis) if tp_axis else 1
         pos = jnp.asarray(pos, jnp.int32)
         positions = pos[:, None]  # [b, 1]: per-row position of the 1 new token
         h = self._embed_spmd(params, tokens[:, None], tp_axis, seq_offset=positions)
         valid = jnp.arange(cfg.max_seq)[None, :] <= pos[:, None]  # [b, S]
         bidx = jnp.arange(b)
-        new_cache = []
-        for layer, c in zip(params["layers"], cache):
-            x = self._norm1(layer, h)
-            q, kc, vc, _, _ = self._serving_qkv(layer, x, positions, tp_size)
-            ck = c["k"].at[bidx, :, pos, :].set(kc[:, :, 0, :])
-            cv = c["v"].at[bidx, :, pos, :].set(vc[:, :, 0, :])
-            out = self._decode_attention(q, ck, cv, valid)
-            attn_out = self._merge_heads(out) @ layer["attn"]["wo"]
-            if tp_axis:
-                attn_out = lax.psum(attn_out, tp_axis)
-            h = h + attn_out + self._attn_out_bias(layer)
-            h = self._ffn(layer, h, tp_axis)
-            new_cache.append({"k": ck, "v": cv})
-        h = self._final_norm(params, h)
-        return self._unembed_full(params, h[:, 0], tp_axis), new_cache
+        return self._decode_core(
+            params, cache, h, positions, valid,
+            lambda arr, new: arr.at[bidx, :, pos, :].set(new[:, :, 0, :]),
+            tp_axis,
+        )
 
     def generate(
         self,

@@ -66,6 +66,9 @@ class LlamaConfig:
     remat: bool | str = False
     xent_chunk: int = 8192
     pp_interleave: int = 1
+    # int8 KV cache with per-position scales (see GPT2Config.kv_quant) —
+    # stacks with the GQA cache's kv-heads-only memory win
+    kv_quant: bool = False
 
     @staticmethod
     def tinyllama_1b() -> "LlamaConfig":
@@ -342,14 +345,8 @@ class Llama(GPT2):
         cfg = self.config
         if cfg.n_kv_head % tp_size:
             raise ValueError(f"n_kv_head={cfg.n_kv_head} not divisible by tp={tp_size}")
-        hd = cfg.d_model // cfg.n_head
-        n_kv_local = cfg.n_kv_head // tp_size
-        dt = jnp.dtype(cfg.dtype)
         return [
-            {
-                "k": jnp.zeros((batch, n_kv_local, cfg.max_seq, hd), dt),
-                "v": jnp.zeros((batch, n_kv_local, cfg.max_seq, hd), dt),
-            }
+            self._cache_entry(batch, cfg.n_kv_head // tp_size)
             for _ in range(cfg.n_layer)
         ]
 
@@ -367,21 +364,33 @@ class Llama(GPT2):
             layer, x, cfg.n_head // tp_size, cfg.n_kv_head // tp_size, positions
         )
 
-    def _decode_attention(self, q, ck, cv, valid):
+    def _decode_attention(self, q, ck, cv, valid, k_s=None, v_s=None):
         """Grouped-query attention against the kv-head cache — query heads
         grouped over their kv head, no materialized repeat; scores
         accumulate f32 via preferred_element_type (no full-cache upcast
         copies on the decode hot path). ``valid`` is [S] (shared depth) or
-        [b, S] (per-slot depth, continuous batching)."""
+        [b, S] (per-slot depth, continuous batching); ``k_s``/``v_s``
+        [b, kv, S, 1] are the int8 cache's per-position scales, folded in
+        after each dot so the dequantize never materializes a full-width
+        cache copy (see ``GPT2._cache_attn_inputs``)."""
         b, hq, s, hd = q.shape
         repeat = hq // ck.shape[1]
         qg = q.reshape(b, hq // repeat, repeat, s, hd)
         scores = jnp.einsum(
-            "bgrqd,bgkd->bgrqk", qg, ck, preferred_element_type=jnp.float32
+            "bgrqd,bgkd->bgrqk", qg, ck.astype(q.dtype) if k_s is not None else ck,
+            preferred_element_type=jnp.float32,
         ) * (hd ** -0.5)
+        if k_s is not None:
+            # [b, kv, S, 1] → [b, kv, 1, 1, S]: per-key-position scale
+            scores = scores * jnp.swapaxes(k_s, -1, -2)[:, :, None]
         vmask = valid[None, None, None, None, :] if valid.ndim == 1 else valid[:, None, None, None, :]
         scores = jnp.where(vmask, scores, _NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if v_s is not None:
+            probs = probs * jnp.swapaxes(v_s, -1, -2)[:, :, None]
+            cv = cv.astype(jnp.float32)
+        else:
+            probs = probs.astype(cv.dtype)
         # bf16 inputs feed the MXU at full rate; f32 accumulation keeps the
         # long-context value sum from drifting (same precision as the scores)
         out = jnp.einsum("bgrqk,bgkd->bgrqd", probs, cv,

@@ -60,10 +60,20 @@ class ContinuousBatcher:
     """Slot-based continuous-batching decoder over one model + params.
 
     ``submit`` enqueues prompts; ``step`` admits queued requests into free
-    slots (bucketed prefill), runs ONE slot-decode step, emits new tokens,
+    slots (bucketed prefill), runs one decode QUANTUM, emits new tokens,
     and retires finished requests (EOS or token budget). ``run`` drains
     everything. Greedy by default; ``temperature > 0`` samples with a
-    per-request fold of ``seed`` so results don't depend on slot timing.
+    per-(request, step) fold of ``seed`` so results don't depend on slot
+    timing.
+
+    ``decode_quantum`` — tokens decoded per scheduler tick, chained inside
+    ONE jitted ``lax.scan`` (sampling included). 1 = retire/admit at every
+    token (max lane utilization). Each tick costs one host↔device round
+    trip, which on a tunneled TPU (~100 ms RTT) or any small model dwarfs
+    the step compute — a quantum of k amortizes that k× at the cost of up
+    to k−1 wasted lane-ticks when a request finishes mid-quantum
+    (iteration-level vs token-level scheduling, the Orca trade-off).
+    Tokens are IDENTICAL for any quantum; only throughput changes.
     """
 
     def __init__(
@@ -75,6 +85,7 @@ class ContinuousBatcher:
         temperature: float = 0.0,
         seed: int = 0,
         prompt_buckets: tuple = (32, 64, 128, 256, 512, 1024),
+        decode_quantum: int = 1,
     ):
         cfg = model.config
         self.model = model
@@ -95,15 +106,46 @@ class ContinuousBatcher:
         self._slot_rid = np.full(n_slots, -1, np.int64)  # -1 = free
         self._pos = np.zeros(n_slots, np.int32)  # next cache write index
         self._last_tok = np.zeros(n_slots, np.int32)
+        self._slot_key = np.zeros((n_slots, 2), np.uint32)  # rid-derived PRNG keys
         self._cache = model.init_cache(n_slots)
 
-        # the cache is donated: XLA updates it in place each step instead of
+        if decode_quantum < 1:
+            raise ValueError(f"decode_quantum must be >= 1, got {decode_quantum}")
+        self.decode_quantum = decode_quantum
+        max_seq = cfg.max_seq
+        temperature = self.temperature
+        from jax import lax
+
+        def decode_k(p, c, t, pos, base_keys, steps_done):
+            """k chained slot-decode steps + sampling in ONE program.
+            ``base_keys`` [B, 2] per-slot PRNG keys (rid-derived),
+            ``steps_done`` [B] tokens already emitted per request (the
+            sampler's step index). Positions clamp at max_seq-1: slots that
+            retire mid-quantum keep writing their (dead) last row, which
+            the next prefill overwrites."""
+
+            def body(carry, i):
+                c, t, pos = carry
+                logits, c = model.decode_step_slots(p, c, t, pos)
+                if temperature <= 0.0:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    def one(row, key, n_done):
+                        k2 = jax.random.fold_in(key, n_done + i)
+                        return jax.random.categorical(
+                            k2, row.astype(jnp.float32) / temperature
+                        ).astype(jnp.int32)
+
+                    nxt = jax.vmap(one)(logits, base_keys, steps_done)
+                return (c, nxt, jnp.minimum(pos + 1, max_seq - 1)), nxt
+
+            (c, _, _), toks = lax.scan(body, (c, t, pos), jnp.arange(decode_quantum))
+            return toks, c  # toks [k, B]
+
+        # the cache is donated: XLA updates it in place each tick instead of
         # allocating + copying the full [slots, H, max_seq, hd] buffers per
         # token (params are NOT donated — they serve every step)
-        self._decode = jax.jit(
-            lambda p, c, t, pos: model.decode_step_slots(p, c, t, pos),
-            donate_argnums=(1,),
-        )
+        self._decode = jax.jit(decode_k, donate_argnums=(1,))
         # one prefill compile per bucket length (static last_index would
         # recompile per prompt length — keep it traced)
         self._prefill = jax.jit(
@@ -114,12 +156,10 @@ class ContinuousBatcher:
     @staticmethod
     def _insert_fn(cache, cache1, slot):
         """Scatter a 1-row prefill cache into slot ``slot`` of the big
-        cache (the admission write)."""
+        cache (the admission write). Layout-generic over the entry keys so
+        quantized caches (k/k_s/v/v_s) ride the same path."""
         return [
-            {
-                "k": c["k"].at[slot].set(c1["k"][0]),
-                "v": c["v"].at[slot].set(c1["v"][0]),
-            }
+            {key: c[key].at[slot].set(c1[key][0]) for key in c}
             for c, c1 in zip(cache, cache1)
         ]
 
@@ -127,19 +167,15 @@ class ContinuousBatcher:
 
     def submit(self, prompt, max_new_tokens: int) -> int:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        cfg = self.model.config
         if len(prompt) < 1:
             raise ValueError("empty prompt")
-        if len(prompt) + max_new_tokens > cfg.max_seq:
-            raise ValueError(
-                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds max_seq={cfg.max_seq}"
-            )
+        # the SAME validation generate applies (length budget, max_new >= 1,
+        # temperature range) — duplicating it here would let the two paths'
+        # contracts drift apart
+        self.model._check_generate_args(
+            len(prompt), max_new_tokens, self.temperature, 0, 0.0
+        )
         _bucket(len(prompt), self.prompt_buckets)  # reject at submit, not admit
-        if max_new_tokens < 1:
-            # generate raises for this too — the serving path must not
-            # silently emit a token for a zero-budget request
-            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         rid = self._next_rid
         self._next_rid += 1
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens)
@@ -157,19 +193,28 @@ class ContinuousBatcher:
 
     # ---- scheduling ------------------------------------------------------------
 
+    def _request_key(self, rid: int):
+        """The rid-derived base PRNG key — THE one derivation shared by the
+        host sampler, the slot-key table, and (folded with the step index)
+        the in-scan sampler; the quantum/slot-independence guarantees rest
+        on all samplers folding the identical (seed, rid, step) sequence."""
+        return jax.random.fold_in(jax.random.PRNGKey(self.seed), rid)
+
     def _sample(self, logits: np.ndarray, req: Request) -> int:
         if self.temperature <= 0.0:
             return int(np.argmax(logits))
-        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), req.rid)
-        key = jax.random.fold_in(key, len(req.tokens))
+        key = jax.random.fold_in(self._request_key(req.rid), len(req.tokens))
         scaled = jnp.asarray(logits, jnp.float32) / self.temperature
         return int(jax.random.categorical(key, scaled))
 
-    def _admit(self) -> None:
+    def _admit(self) -> dict[int, list]:
         """Fill free slots from the queue: bucketed prefill + cache insert +
         first sampled token. A request that finishes AT prefill (budget 1 or
         immediate EOS) never occupies the slot, so the same slot admits the
-        next queued request within this pass."""
+        next queued request within this pass. Returns {rid: [first token]}
+        for every admission — step() merges it so streaming consumers see
+        token 1 too."""
+        emitted: dict[int, list] = {}
         for slot in np.flatnonzero(self._slot_rid < 0):
             while self._queue and self._slot_rid[slot] < 0:
                 req = self._queue.popleft()
@@ -183,12 +228,15 @@ class ContinuousBatcher:
                 self._cache = self._insert(self._cache, cache1, int(slot))
                 tok = self._sample(np.asarray(logits[0]), req)
                 req.tokens.append(tok)
+                emitted[req.rid] = [tok]
                 if self._finished(req, tok):
                     self._retire(req)  # slot still free: while-loop admits next
                     continue
                 self._slot_rid[slot] = req.rid
                 self._pos[slot] = L
                 self._last_tok[slot] = tok
+                self._slot_key[slot] = np.asarray(self._request_key(req.rid))
+        return emitted
 
     def _finished(self, req: Request, tok: int) -> bool:
         return (self.eos_id is not None and tok == self.eos_id) or (
@@ -201,31 +249,43 @@ class ContinuousBatcher:
         # accumulate one Request per lifetime request; collect() drains
         self._done[req.rid] = self._live.pop(req.rid)
 
-    def step(self) -> dict[int, int]:
-        """One scheduler tick: admit, one decode step over ALL slots, emit.
-        Returns {rid: new token} for every active request this tick."""
-        self._admit()
+    def step(self) -> dict[int, list]:
+        """One scheduler tick: admit, one decode QUANTUM over ALL slots,
+        emit. Returns {rid: [new tokens]} for every request that produced
+        tokens this tick — including each admission's prefill-sampled first
+        token (a request finishing mid-quantum gets its truncated tail; the
+        over-decoded lane-ticks are the quantum's scheduling cost)."""
+        emitted = self._admit()
         active = np.flatnonzero(self._slot_rid >= 0)
         if len(active) == 0:
-            return {}
-        logits, self._cache = self._decode(
+            return emitted
+        steps_done = np.asarray(
+            [len(self._live[rid].tokens) if rid >= 0 else 0 for rid in self._slot_rid],
+            np.int32,
+        )
+        toks, self._cache = self._decode(
             self.params,
             self._cache,
             jnp.asarray(self._last_tok),
             jnp.asarray(self._pos),
+            jnp.asarray(self._slot_key),
+            jnp.asarray(steps_done),
         )
-        logits = np.asarray(logits)
-        emitted: dict[int, int] = {}
+        toks = np.asarray(toks)  # [quantum, n_slots]
         for slot in active:
             req = self._live[int(self._slot_rid[slot])]
-            tok = self._sample(logits[slot], req)
-            req.tokens.append(tok)
-            emitted[req.rid] = tok
-            self._pos[slot] += 1
-            self._last_tok[slot] = tok
-            if self._finished(req, tok):
-                self._retire(req)
-                self._slot_rid[slot] = -1  # slot freed → next admit reuses it
+            new = emitted.setdefault(req.rid, [])
+            for i in range(self.decode_quantum):
+                tok = int(toks[i, slot])
+                req.tokens.append(tok)
+                new.append(tok)
+                if self._finished(req, tok):
+                    self._retire(req)
+                    self._slot_rid[slot] = -1  # freed → next admit reuses it
+                    break
+            if self._slot_rid[slot] >= 0:  # request continues
+                self._pos[slot] += self.decode_quantum
+                self._last_tok[slot] = int(toks[-1, slot])
         return emitted
 
     def collect(self) -> dict[int, list]:

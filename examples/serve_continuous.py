@@ -34,6 +34,8 @@ class ServeConfig(Config):
     family: str = field("gpt2", help="model family: gpt2 | llama")
     model: str = field("tiny", help="model preset (tiny for the demo)")
     n_slots: int = field(4, help="decode slots (concurrent requests)")
+    quantum: int = field(1, help="tokens decoded per scheduler tick (one jitted "
+                         "scan; amortizes the per-tick host round trip)")
     requests: int = field(12, help="number of requests in the workload")
     max_new_max: int = field(24, help="largest per-request token budget")
     temperature: float = field(0.0, help="0 = greedy")
@@ -67,20 +69,43 @@ def main() -> None:
     # ---- continuous batching ---------------------------------------------------
     srv = ContinuousBatcher(
         model, params, n_slots=cfg.n_slots, temperature=cfg.temperature,
-        seed=cfg.seed, prompt_buckets=(16, 32, 64),
+        seed=cfg.seed, prompt_buckets=(16, 32, 64), decode_quantum=cfg.quantum,
     )
+    # warmup pass: compile every bucket's prefill + the decode program so
+    # the timed pass measures steady-state serving, not compilation
+    for p, n in zip(prompts, budgets):
+        srv.submit(p, int(n))
+    srv.run()
     rids = [srv.submit(p, int(n)) for p, n in zip(prompts, budgets)]
     t0 = time.monotonic()
     steps = 0
     useful_ticks = 0  # decode-lane ticks that produced a wanted token
     while srv.n_queued or srv.n_active:
-        useful_ticks += len(srv.step())
+        useful_ticks += sum(len(v) for v in srv.step().values())
         steps += 1
     cont_s = time.monotonic() - t0
+    srv.collect()
 
     # ---- static-batch baseline: groups of n_slots, everyone waits for the
     # group's longest budget (what a naive batched `generate` loop does) -----
+    def run_static():
+        for i in range(0, cfg.requests, cfg.n_slots):
+            group = list(range(i, min(i + cfg.n_slots, cfg.requests)))
+            n_max = int(max(budgets[g] for g in group))
+            width = int(max(lengths[g] for g in group))
+            batch = np.zeros((len(group), width), np.int32)
+            for row, g in enumerate(group):
+                batch[row, width - lengths[g]:] = prompts[g]  # left-pad
+            # np.asarray forces execution — async dispatch would otherwise
+            # let the timer stop before the device finishes
+            np.asarray(model.generate(
+                params, batch, n_max, temperature=cfg.temperature, seed=cfg.seed
+            ))
+
+    run_static()  # warmup: compile per-group shapes
     t0 = time.monotonic()
+    run_static()
+    static_s = time.monotonic() - t0
     static_useful = 0
     static_ticks = 0
     for i in range(0, cfg.requests, cfg.n_slots):
@@ -90,14 +115,8 @@ def main() -> None:
         # prefill, same as the batcher); wanted ticks per request likewise
         static_useful += sum(int(budgets[g]) - 1 for g in group)
         static_ticks += (n_max - 1) * cfg.n_slots
-        width = int(max(lengths[g] for g in group))
-        batch = np.zeros((len(group), width), np.int32)
-        for row, g in enumerate(group):
-            batch[row, width - lengths[g]:] = prompts[g]  # left-pad
-        model.generate(params, batch, n_max, temperature=0.0, seed=cfg.seed)
-    static_s = time.monotonic() - t0
 
-    util = useful_ticks / max(steps * cfg.n_slots, 1)
+    util = useful_ticks / max(steps * cfg.n_slots * cfg.quantum, 1)
     static_util = static_useful / max(static_ticks, 1)
     log.info(
         "continuous: %.2fs (%d scheduler steps, lane utilization %.0f%%)",
@@ -110,6 +129,14 @@ def main() -> None:
     log.info(
         "tokens/s: continuous %.1f vs static %.1f",
         total_tokens / cont_s, total_tokens / static_s,
+    )
+    log.info(
+        "reading the numbers: static fuses each group's ENTIRE decode into one "
+        "compiled scan (zero host round trips), so it wins offline wall-clock "
+        "at toy scale; continuous batching wins lane UTILIZATION (above), "
+        "online arrival (it starts serving immediately), and tail latency — "
+        "raise --quantum to amortize the per-tick round trip (the dominant "
+        "cost over a tunneled TPU)"
     )
 
 
