@@ -86,10 +86,17 @@ class ContinuousBatcher:
         seed: int = 0,
         prompt_buckets: tuple = (32, 64, 128, 256, 512, 1024),
         decode_quantum: int = 1,
+        mesh=None,
     ):
+        """``mesh`` — a framework mesh (``parallel.mesh.build_mesh``) makes
+        serving TENSOR-PARALLEL: params are Megatron-sharded
+        (``model.param_specs()``), the slot cache's head axis shards over
+        'tp', and prefill/decode run head-parallel under shard_map with the
+        full logits row reconstructed for sampling — same tokens as the
+        single-device batcher (tests pin it)."""
         cfg = model.config
         self.model = model
-        self.params = params
+        self.mesh = mesh
         self.n_slots = n_slots
         self.eos_id = eos_id
         self.temperature = float(temperature)
@@ -107,13 +114,13 @@ class ContinuousBatcher:
         self._pos = np.zeros(n_slots, np.int32)  # next cache write index
         self._last_tok = np.zeros(n_slots, np.int32)
         self._slot_key = np.zeros((n_slots, 2), np.uint32)  # rid-derived PRNG keys
-        self._cache = model.init_cache(n_slots)
 
         if decode_quantum < 1:
             raise ValueError(f"decode_quantum must be >= 1, got {decode_quantum}")
         self.decode_quantum = decode_quantum
         max_seq = cfg.max_seq
         temperature = self.temperature
+        tp_axis = "tp" if mesh is not None else None
         from jax import lax
 
         def decode_k(p, c, t, pos, base_keys, steps_done):
@@ -126,7 +133,7 @@ class ContinuousBatcher:
 
             def body(carry, i):
                 c, t, pos = carry
-                logits, c = model.decode_step_slots(p, c, t, pos)
+                logits, c = model.decode_step_slots(p, c, t, pos, tp_axis)
                 if temperature <= 0.0:
                     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 else:
@@ -142,15 +149,58 @@ class ContinuousBatcher:
             (c, _, _), toks = lax.scan(body, (c, t, pos), jnp.arange(decode_quantum))
             return toks, c  # toks [k, B]
 
-        # the cache is donated: XLA updates it in place each tick instead of
-        # allocating + copying the full [slots, H, max_seq, hd] buffers per
-        # token (params are NOT donated — they serve every step)
-        self._decode = jax.jit(decode_k, donate_argnums=(1,))
-        # one prefill compile per bucket length (static last_index would
-        # recompile per prompt length — keep it traced)
-        self._prefill = jax.jit(
-            lambda p, toks, last: model.prefill(p, toks, last_index=last)
-        )
+        def prefill_fn(p, toks, last):
+            return model.prefill(p, toks, tp_axis, last_index=last)
+
+        if mesh is None:
+            self.params = params
+            self._cache = model.init_cache(n_slots)
+            # the cache is donated: XLA updates it in place each tick
+            # instead of allocating + copying the full [slots, H, max_seq,
+            # hd] buffers per token (params are NOT donated — they serve
+            # every step)
+            self._decode = jax.jit(decode_k, donate_argnums=(1,))
+            # one prefill compile per bucket length (static last_index
+            # would recompile per prompt length — keep it traced)
+            self._prefill = jax.jit(prefill_fn)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from dsml_tpu.parallel.hybrid import shard_params
+
+            tp_size = mesh.shape.get("tp", 1)
+            n_heads = getattr(cfg, "n_kv_head", cfg.n_head)
+            if n_heads % tp_size:
+                raise ValueError(
+                    f"cache head count {n_heads} not divisible by tp={tp_size}"
+                )
+            pspecs = model.param_specs()
+            self.params = shard_params(params, mesh, pspecs)
+            # global cache (full heads), head axis sharded over tp; every
+            # other mesh axis replicates it
+            cache_global = model.init_cache(n_slots)
+            head_sh = NamedSharding(mesh, P(None, "tp"))
+            self._cache = jax.tree.map(
+                lambda a: jax.device_put(a, head_sh), cache_global
+            )
+            cache_spec = jax.tree.map(lambda _: P(None, "tp"), cache_global)
+            self._decode = jax.jit(
+                jax.shard_map(
+                    decode_k, mesh=mesh,
+                    in_specs=(pspecs, cache_spec, P(), P(), P(), P()),
+                    out_specs=(P(), cache_spec),
+                    check_vma=False,
+                ),
+                donate_argnums=(1,),
+            )
+            self._prefill = jax.jit(
+                jax.shard_map(
+                    prefill_fn, mesh=mesh,
+                    in_specs=(pspecs, P(), P()),
+                    out_specs=(P(), cache_spec),
+                    check_vma=False,
+                )
+            )
         self._insert = jax.jit(self._insert_fn, donate_argnums=(0,))
 
     @staticmethod
