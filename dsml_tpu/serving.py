@@ -74,6 +74,17 @@ class ContinuousBatcher:
     to k−1 wasted lane-ticks when a request finishes mid-quantum
     (iteration-level vs token-level scheduling, the Orca trade-off).
     Tokens are IDENTICAL for any quantum; only throughput changes.
+
+    ``prefill_chunk`` — when > 0, admission prefills prompts in chunks of
+    that many tokens via ``model.prefill_chunk``, running at most one
+    chunk per scheduler tick once a long admission is in flight: decode
+    quanta continue BETWEEN a long prompt's chunks instead of every
+    active slot stalling for the whole prefill (the head-of-line problem
+    of whole-prompt admission; Orca/vLLM chunked prefill). Tokens are
+    identical either way (chunk chaining == whole-prompt prefill — pinned
+    in tests; with ``kv_quant`` the chunk path reads int8 cache rows for
+    within-prompt attention, the standard chunked-prefill approximation).
+    0 (default) keeps whole-prompt bucketed admission.
     """
 
     def __init__(
@@ -86,6 +97,7 @@ class ContinuousBatcher:
         seed: int = 0,
         prompt_buckets: tuple = (32, 64, 128, 256, 512, 1024),
         decode_quantum: int = 1,
+        prefill_chunk: int = 0,
         mesh=None,
     ):
         """``mesh`` — a framework mesh (``parallel.mesh.build_mesh``) makes
@@ -101,9 +113,22 @@ class ContinuousBatcher:
         self.eos_id = eos_id
         self.temperature = float(temperature)
         self.seed = seed
-        self.prompt_buckets = tuple(b for b in prompt_buckets if b <= cfg.max_seq)
+        # sorted + deduped: _bucket picks the FIRST bucket >= len(prompt),
+        # so an unsorted tuple would silently admit short prompts into the
+        # largest bucket, wasting prefill compiles/compute
+        self.prompt_buckets = tuple(sorted({b for b in prompt_buckets if b <= cfg.max_seq}))
         if not self.prompt_buckets:
             raise ValueError(f"no prompt bucket fits max_seq={cfg.max_seq}")
+        if prefill_chunk < 0 or prefill_chunk > cfg.max_seq:
+            raise ValueError(
+                f"prefill_chunk must be in [0, max_seq={cfg.max_seq}], got {prefill_chunk}"
+            )
+        self.prefill_chunk = int(prefill_chunk)
+        # the in-flight chunked admission: (request, reserved slot,
+        # accumulating 1-row cache, next chunk's start position) — at most
+        # one at a time; its reserved slot holds rid -2 so neither the
+        # decode mask (>= 0) nor the free-slot scan (== -1) touches it
+        self._pending = None
 
         self._queue: deque[Request] = deque()
         self._live: dict[int, Request] = {}  # queued or in a slot
@@ -152,6 +177,9 @@ class ContinuousBatcher:
         def prefill_fn(p, toks, last):
             return model.prefill(p, toks, tp_axis, last_index=last)
 
+        def prefill_chunk_fn(p, c, toks, start, last):
+            return model.prefill_chunk(p, c, toks, start, tp_axis, last_index=last)
+
         if mesh is None:
             self.params = params
             self._cache = model.init_cache(n_slots)
@@ -163,6 +191,9 @@ class ContinuousBatcher:
             # one prefill compile per bucket length (static last_index
             # would recompile per prompt length — keep it traced)
             self._prefill = jax.jit(prefill_fn)
+            # ONE compile serves every chunk: start/last_index stay traced
+            self._prefill_chunk = jax.jit(prefill_chunk_fn, donate_argnums=(1,))
+            self._fresh_cache1 = lambda: model.init_cache(1)
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -201,6 +232,18 @@ class ContinuousBatcher:
                     check_vma=False,
                 )
             )
+            self._prefill_chunk = jax.jit(
+                jax.shard_map(
+                    prefill_chunk_fn, mesh=mesh,
+                    in_specs=(pspecs, cache_spec, P(), P(), P()),
+                    out_specs=(P(), cache_spec),
+                    check_vma=False,
+                ),
+                donate_argnums=(1,),
+            )
+            self._fresh_cache1 = lambda: jax.tree.map(
+                lambda a: jax.device_put(a, head_sh), model.init_cache(1)
+            )
         self._insert = jax.jit(self._insert_fn, donate_argnums=(0,))
 
     @staticmethod
@@ -225,7 +268,9 @@ class ContinuousBatcher:
         self.model._check_generate_args(
             len(prompt), max_new_tokens, self.temperature, 0, 0.0
         )
-        _bucket(len(prompt), self.prompt_buckets)  # reject at submit, not admit
+        if not self._chunk_grid_fits(len(prompt)):
+            # whole-prompt bucketed admission → reject at submit, not admit
+            _bucket(len(prompt), self.prompt_buckets)
         rid = self._next_rid
         self._next_rid += 1
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens)
@@ -240,6 +285,13 @@ class ContinuousBatcher:
     @property
     def n_queued(self) -> int:
         return len(self._queue)
+
+    @property
+    def n_pending(self) -> int:
+        """Chunked admissions currently mid-prefill (0 or 1) — queued in
+        neither ``n_queued`` nor ``n_active``; drain loops must check all
+        three (``run`` does)."""
+        return 0 if self._pending is None else 1
 
     # ---- scheduling ------------------------------------------------------------
 
@@ -257,36 +309,111 @@ class ContinuousBatcher:
         scaled = jnp.asarray(logits, jnp.float32) / self.temperature
         return int(jax.random.categorical(key, scaled))
 
+    def _chunk_grid_fits(self, prompt_len: int) -> bool:
+        """True when the chunked path serves this prompt: chunking is on
+        and the padded chunk grid ceil(L/C)·C stays inside max_seq (the
+        final chunk is right-padded to C, and its padded K/V rows must not
+        wrap past the cache end). With C dividing max_seq — every default —
+        this is simply L <= max_seq."""
+        c = self.prefill_chunk
+        if c <= 0:
+            return False
+        return -(-prompt_len // c) * c <= self.model.config.max_seq
+
+    def _occupy(self, req: Request, slot: int, tok: int) -> None:
+        """Install an admitted (not-yet-finished) request into its slot."""
+        self._slot_rid[slot] = req.rid
+        self._pos[slot] = len(req.prompt)
+        self._last_tok[slot] = tok
+        self._slot_key[slot] = np.asarray(self._request_key(req.rid))
+
+    def _admit_full(self, req: Request, slot: int, emitted: dict) -> None:
+        """Whole-prompt bucketed prefill + cache insert + first sampled
+        token. A request that finishes AT prefill (budget 1 or immediate
+        EOS) never occupies the slot."""
+        L = len(req.prompt)
+        bucket = _bucket(L, self.prompt_buckets)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :L] = req.prompt
+        logits, cache1 = self._prefill(
+            self.params, jnp.asarray(padded), jnp.int32(L - 1)
+        )
+        self._cache = self._insert(self._cache, cache1, slot)
+        tok = self._sample(np.asarray(logits[0]), req)
+        req.tokens.append(tok)
+        emitted[req.rid] = [tok]
+        if self._finished(req, tok):
+            self._retire(req)
+            return
+        self._occupy(req, slot, tok)
+
     def _admit(self) -> dict[int, list]:
-        """Fill free slots from the queue: bucketed prefill + cache insert +
-        first sampled token. A request that finishes AT prefill (budget 1 or
-        immediate EOS) never occupies the slot, so the same slot admits the
-        next queued request within this pass. Returns {rid: [first token]}
-        for every admission — step() merges it so streaming consumers see
-        token 1 too."""
+        """Fill free slots from the queue (whole-prompt admission path).
+        Returns {rid: [first token]} for every admission — step() merges it
+        so streaming consumers see token 1 too."""
         emitted: dict[int, list] = {}
-        for slot in np.flatnonzero(self._slot_rid < 0):
-            while self._queue and self._slot_rid[slot] < 0:
-                req = self._queue.popleft()
-                L = len(req.prompt)
-                bucket = _bucket(L, self.prompt_buckets)
-                padded = np.zeros((1, bucket), np.int32)
-                padded[0, :L] = req.prompt
-                logits, cache1 = self._prefill(
-                    self.params, jnp.asarray(padded), jnp.int32(L - 1)
-                )
-                self._cache = self._insert(self._cache, cache1, int(slot))
-                tok = self._sample(np.asarray(logits[0]), req)
-                req.tokens.append(tok)
-                emitted[req.rid] = [tok]
-                if self._finished(req, tok):
-                    self._retire(req)  # slot still free: while-loop admits next
-                    continue
-                self._slot_rid[slot] = req.rid
-                self._pos[slot] = L
-                self._last_tok[slot] = tok
-                self._slot_key[slot] = np.asarray(self._request_key(req.rid))
+        for slot in np.flatnonzero(self._slot_rid == -1):
+            while self._queue and self._slot_rid[slot] == -1:
+                self._admit_full(self._queue.popleft(), int(slot), emitted)
         return emitted
+
+    def _advance_pending(self, emitted: dict) -> bool:
+        """Run ONE chunk of the in-flight chunked admission. On the final
+        chunk: sample the first token, insert the accumulated cache into
+        the reserved slot, and occupy (or retire) it. Returns True when the
+        admission completed this call."""
+        req, slot, cache1, start = self._pending
+        c = self.prefill_chunk
+        L = len(req.prompt)
+        end = min(start + c, L)
+        padded = np.zeros((1, c), np.int32)
+        padded[0, : end - start] = req.prompt[start:end]
+        is_last = end >= L
+        last_local = (L - 1) - start if is_last else c - 1
+        logits, cache1 = self._prefill_chunk(
+            self.params, cache1, jnp.asarray(padded),
+            jnp.int32(start), jnp.int32(last_local),
+        )
+        if not is_last:
+            self._pending = (req, slot, cache1, start + c)
+            return False
+        self._pending = None
+        self._cache = self._insert(self._cache, cache1, slot)
+        tok = self._sample(np.asarray(logits[0]), req)
+        req.tokens.append(tok)
+        emitted[req.rid] = [tok]
+        if self._finished(req, tok):
+            self._retire(req)
+            self._slot_rid[slot] = -1  # release the reservation
+            return True
+        self._occupy(req, slot, tok)
+        return True
+
+    def _admit_chunked(self) -> dict[int, list]:
+        """Chunked admission pass: advance the in-flight admission by ONE
+        chunk; when an admission completes (short prompts complete in one
+        chunk), keep admitting from the queue, so cold-start still fills
+        every free slot in a single tick. The moment a LONG prompt's chunk
+        finishes without completing the admission, the pass yields — decode
+        quanta run between its remaining chunks (no head-of-line stall)."""
+        emitted: dict[int, list] = {}
+        while True:
+            if self._pending is not None:
+                if not self._advance_pending(emitted):
+                    return emitted  # long admission mid-flight: decode now
+                continue  # completed → maybe start the next admission
+            free = np.flatnonzero(self._slot_rid == -1)
+            if len(free) == 0 or not self._queue:
+                return emitted
+            req = self._queue.popleft()
+            if not self._chunk_grid_fits(len(req.prompt)):
+                # odd max_seq where the padded grid would overrun the cache:
+                # this request rides the bucketed whole-prompt path
+                self._admit_full(req, int(free[0]), emitted)
+                continue
+            slot = int(free[0])
+            self._slot_rid[slot] = -2  # reserve: not free, not decoding
+            self._pending = (req, slot, self._fresh_cache1(), 0)
 
     def _finished(self, req: Request, tok: int) -> bool:
         return (self.eos_id is not None and tok == self.eos_id) or (
@@ -305,7 +432,7 @@ class ContinuousBatcher:
         tokens this tick — including each admission's prefill-sampled first
         token (a request finishing mid-quantum gets its truncated tail; the
         over-decoded lane-ticks are the quantum's scheduling cost)."""
-        emitted = self._admit()
+        emitted = self._admit_chunked() if self.prefill_chunk else self._admit()
         active = np.flatnonzero(self._slot_rid >= 0)
         if len(active) == 0:
             return emitted
@@ -335,6 +462,16 @@ class ContinuousBatcher:
                     break
             if self._slot_rid[slot] >= 0:  # request continues
                 self._pos[slot] += self.decode_quantum
+                # the jitted scan clamps its cache writes at max_seq-1; a
+                # CONTINUING request must never need that clamp (submit()'s
+                # L + max_new <= max_seq budget guarantees the next write
+                # index is in range). Surface the invariant here rather
+                # than silently diverge from the device-side positions.
+                assert self._pos[slot] < self.model.config.max_seq, (
+                    f"slot {slot} position {self._pos[slot]} escaped max_seq="
+                    f"{self.model.config.max_seq}; host/device cache positions"
+                    " have diverged"
+                )
                 self._last_tok[slot] = int(toks[-1, slot])
         return emitted
 
@@ -350,7 +487,7 @@ class ContinuousBatcher:
         """Drain queue + slots; returns {rid: [tokens]} for every request
         retired during (or before) this call."""
         for _ in range(max_steps):
-            if not self._queue and self.n_active == 0:
+            if not self._queue and self.n_active == 0 and self.n_pending == 0:
                 break
             self.step()
         else:

@@ -16,6 +16,7 @@ batch iterator (per-device shards laid out for a ``dp`` mesh axis).
 from __future__ import annotations
 
 import gzip
+import hashlib
 import os
 import struct
 from dataclasses import dataclass
@@ -282,11 +283,14 @@ def build_prose_corpus(max_bytes: int = 4_000_000) -> str:
     import inspect
 
     parts: list[str] = []
-    seen: set[int] = set()
+    seen: set[bytes] = set()
 
     def add(text: str | None):
         if text and len(text) > 40:
-            h = hash(text)
+            # stable digest, NOT builtin hash(): str hashing is salted per
+            # process, so a hash() collision could drop different texts in
+            # different runs and break the determinism promised above
+            h = hashlib.sha1(text.encode("utf-8", "replace")).digest()
             if h not in seen:
                 seen.add(h)
                 parts.append(text)

@@ -210,6 +210,173 @@ def test_tp_sharded_batcher_llama_kv_quant(devices8):
     assert srv._cache[0]["k"].dtype == jnp.int8
 
 
+def test_prefill_chunk_chain_matches_whole_prompt_prefill():
+    """Model-level pin: chaining ceil(L/C) prefill_chunk calls reproduces
+    prefill — logits at the true last position AND every cache row in
+    [0, L) — for GPT-2, Llama (GQA+RoPE), and the int8 KV cache."""
+    import dataclasses
+
+    from dsml_tpu.models.llama import Llama, LlamaConfig
+
+    cases = [
+        (GPT2(GPT2Config.tiny()), 1e-4),
+        (Llama(LlamaConfig.tiny()), 1e-4),
+        # kv_quant: within-prompt attention reads int8 rows (whole-prompt
+        # prefill attends exactly) — the documented chunked-prefill
+        # approximation, so a looser but still tight bound
+        (GPT2(dataclasses.replace(GPT2Config.tiny(), kv_quant=True)), 5e-2),
+    ]
+    for model, tol in cases:
+        params = model.init(12)
+        cfg = model.config
+        rng = np.random.default_rng(12)
+        L, C = 37, 16
+        prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, L)), jnp.int32)
+        ref_logits, ref_cache = model.prefill(params, prompt, last_index=L - 1)
+        cache = model.init_cache(1)
+        for i in range(-(-L // C)):
+            s, e = i * C, min((i + 1) * C, L)
+            padded = np.zeros((1, C), np.int32)
+            padded[0, : e - s] = np.asarray(prompt[0, s:e])
+            last = (L - 1) - s if e >= L else C - 1
+            logits, cache = model.prefill_chunk(
+                params, cache, jnp.asarray(padded), s, last_index=last
+            )
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref_logits), atol=tol, rtol=0,
+            err_msg=type(model).__name__,
+        )
+
+        def effective(entry):
+            """Dequantized K/V rows [0, L) — the values attention consumes
+            (raw int8 codes can differ by one step when the underlying
+            float differs by rounding)."""
+            if "k_s" in entry:
+                return (
+                    np.asarray(entry["k"][:, :, :L], np.float32)
+                    * np.asarray(entry["k_s"][:, :, :L], np.float32),
+                    np.asarray(entry["v"][:, :, :L], np.float32)
+                    * np.asarray(entry["v_s"][:, :, :L], np.float32),
+                )
+            return (
+                np.asarray(entry["k"][:, :, :L], np.float32),
+                np.asarray(entry["v"][:, :, :L], np.float32),
+            )
+
+        for ref_c, c in zip(ref_cache, cache):
+            for ref_arr, arr in zip(effective(ref_c), effective(c)):
+                # layer 0 K/V is attention-free (exact); deeper rows pick up
+                # accumulation-order rounding between the [L, L] whole-prompt
+                # attention and the [C, S] chunk attention
+                np.testing.assert_allclose(
+                    ref_arr, arr, atol=tol, rtol=0, err_msg=type(model).__name__
+                )
+
+
+def test_chunked_prefill_admission_matches_generate():
+    """prefill_chunk is pure scheduling: greedy AND sampled tokens equal
+    the whole-prompt batcher and the standalone generate path, across
+    staggered arrivals and prompts spanning 1..4 chunks."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(13)
+    prompts = _prompts(cfg, [5, 30, 17, 58, 9], seed=13)
+    budgets = [6, 4, 8, 3, 5]
+
+    def serve(chunk, temperature):
+        srv = ContinuousBatcher(model, params, n_slots=2, temperature=temperature,
+                                seed=13, prompt_buckets=(8, 16, 32, 64),
+                                prefill_chunk=chunk)
+        rids = [srv.submit(p, n) for p, n in zip(prompts[:3], budgets[:3])]
+        srv.step()
+        rids += [srv.submit(p, n) for p, n in zip(prompts[3:], budgets[3:])]
+        out = srv.run()
+        return [out[r] for r in rids]
+
+    assert serve(16, 0.0) == serve(0, 0.0)
+    assert serve(16, 0.8) == serve(0, 0.8)
+    for tokens, p, n in zip(serve(16, 0.0), prompts, budgets):
+        assert tokens == _reference(model, params, p, n)
+
+
+def test_chunked_prefill_admission_matches_generate_llama():
+    """The chunked path is model-generic (RoPE positions and the GQA int8
+    cache follow the chunk's global offsets)."""
+    import dataclasses
+
+    from dsml_tpu.models.llama import Llama, LlamaConfig
+
+    model = Llama(dataclasses.replace(LlamaConfig.tiny(), kv_quant=True))
+    cfg = model.config
+    params = model.init(14)
+    prompts = _prompts(cfg, [7, 41, 12], seed=14)
+    srv = ContinuousBatcher(model, params, n_slots=2, prompt_buckets=(16, 64),
+                            prefill_chunk=16)
+    rids = [srv.submit(p, 5) for p in prompts]
+    out = srv.run()
+    for rid, prompt in zip(rids, prompts):
+        assert out[rid] == _reference(model, params, prompt, 5), rid
+
+
+def test_decode_continues_between_chunks_of_long_admission():
+    """THE head-of-line fix (VERDICT r3 item 2): while a long prompt's
+    admission is mid-flight, every scheduler tick still decodes the active
+    slots — tokens keep flowing between the admission's chunks instead of
+    stalling for the whole prefill."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(15)
+    short, long = _prompts(cfg, [5, 100], seed=15)
+
+    srv = ContinuousBatcher(model, params, n_slots=2, prompt_buckets=(8, 128),
+                            prefill_chunk=16)
+    rid_short = srv.submit(short, 40)
+    srv.step()  # short admitted + starts decoding
+    assert srv.n_active == 1
+    rid_long = srv.submit(long, 4)  # 100 tokens → 7 chunks of 16
+
+    chunk_ticks = 0  # ticks that ran with the long admission still pending
+    while srv.n_pending or srv.n_queued:
+        before = len(srv._live[rid_short].tokens)
+        srv.step()
+        if srv.n_pending:
+            chunk_ticks += 1
+            # the short request decoded DURING the long prompt's admission
+            assert len(srv._live[rid_short].tokens) == before + 1
+    # the admission genuinely spanned multiple ticks (7 chunks → >= 6
+    # pending-observed ticks), so the assertion above had real coverage
+    assert chunk_ticks >= 5
+    out = srv.run()
+    assert out[rid_short] == _reference(model, params, short, 40)
+    assert out[rid_long] == _reference(model, params, long, 4)
+
+
+def test_chunked_submit_skips_bucket_limit():
+    """With chunking on, prompts longer than the largest bucket are legal
+    (the chunk grid, not the bucket table, bounds admission); the bucket
+    check still applies when chunking is off."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(16)
+    srv = ContinuousBatcher(model, params, n_slots=1, prompt_buckets=(16,),
+                            prefill_chunk=16)
+    rid = srv.submit(np.zeros(64, np.int32), 2)  # > largest bucket: OK
+    out = srv.run()
+    assert len(out[rid]) == 2
+    with pytest.raises(ValueError, match="exceeds max_seq"):
+        srv.submit(np.zeros(cfg.max_seq, np.int32), 1)
+
+
+def test_prompt_buckets_sorted_and_deduped():
+    """An unsorted/duplicated bucket tuple must not admit short prompts
+    into the largest bucket — the constructor normalizes it."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    srv = ContinuousBatcher(model, model.init(0), n_slots=1,
+                            prompt_buckets=(64, 8, 64, 32))
+    assert srv.prompt_buckets == (8, 32, 64)
+
+
 def test_submit_validation():
     cfg = GPT2Config.tiny()
     model = GPT2(cfg)
