@@ -1,0 +1,118 @@
+"""Round-long TPU evidence watcher.
+
+The tunneled chip dies and revives unpredictably — two rounds of
+end-of-round capture attempts hit a dead tunnel at exactly the wrong
+moment (BENCH_r02/r03 are CPU fallbacks). This watcher inverts the
+policy: probe the chip on a loop for the WHOLE round, and the first time
+it answers, capture the flagship bench sections one subprocess at a time
+(``python bench.py --section NAME``), each of which merges its rows into
+``BENCH_TPU_evidence.json`` the moment it finishes. A tunnel death
+mid-capture costs one section; completed rows persist.
+
+Run detached:  nohup python scripts/tpu_evidence_watch.py > /tmp/tpu_watch.log 2>&1 &
+
+Exits 0 once every section has been captured on a real TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EVIDENCE = os.path.join(REPO, "BENCH_TPU_evidence.json")
+
+# capture order: highest-signal rows first so a short-lived tunnel window
+# still lands the headline (gpt2 tokens/s + MFU) before anything else
+SECTIONS = [
+    ("gpt2", 900),        # ~40 s compile + 10 reps; generous for a slow tunnel
+    ("gpt2_decode", 600),
+    ("allreduce", 600),   # incl. the e2e wire-path row (VERDICT r3 item 7)
+    ("gpt2_seq8k", 900),
+    ("mnist", 600),
+    ("gpt2_medium", 1200),  # biggest compile (~130 s) last
+    ("realtext", 1200),
+    ("serving", 900),
+]
+
+PROBE = (
+    # a CPU fallback must FAIL the probe: 'alive' means a real TPU executes
+    # work, not that jax initialized somewhere (the BENCH_r02/r03 artifacts
+    # are exactly what treating CPU-init as alive produces)
+    "import jax, jax.numpy as jnp;"
+    "assert jax.default_backend() == 'tpu', jax.default_backend();"
+    "print(float((jnp.ones((64,64))@jnp.ones((64,64))).sum()))"
+)
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def probe_alive(timeout: float = 120.0) -> bool:
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE], capture_output=True, text=True,
+            timeout=timeout, cwd=REPO,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def captured_sections() -> set:
+    try:
+        with open(EVIDENCE) as f:
+            return set(json.load(f).get("capture_log", {}))
+    except (OSError, ValueError):
+        return set()
+
+
+def main() -> int:
+    poll_s = float(os.environ.get("TPU_WATCH_POLL_S", 600))
+    skipped: set = set()  # deterministic failures — never retried
+    while True:
+        done = captured_sections() | skipped
+        todo = [(n, t) for n, t in SECTIONS if n not in done]
+        if not todo:
+            log("all sections captured — done")
+            return 0
+        if not probe_alive():
+            log(f"probe dead; {len(todo)} sections pending; sleeping {poll_s:.0f}s")
+            time.sleep(poll_s)
+            continue
+        log(f"chip alive — capturing: {[n for n, _ in todo]}")
+        for name, timeout in todo:
+            t0 = time.monotonic()
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "bench.py", "--section", name],
+                    capture_output=True, text=True, timeout=timeout, cwd=REPO,
+                )
+            except subprocess.TimeoutExpired:
+                log(f"section {name}: TIMEOUT after {timeout}s — tunnel likely died; re-probing")
+                break
+            dt = time.monotonic() - t0
+            if proc.returncode != 0:
+                log(f"section {name}: rc={proc.returncode} in {dt:.0f}s; stderr tail: "
+                    f"{proc.stderr[-400:]}")
+                # rc=4 is run_section's explicit unknown-section signal —
+                # deterministic, never retried. Every other failure
+                # (including a KeyError inside a section's own code) is
+                # treated as possibly transient: back to probing, retried
+                # on the next alive cycle.
+                if proc.returncode == 4:
+                    log(f"section {name}: unknown to bench.py — skipping permanently")
+                    skipped.add(name)
+                    continue
+                break
+            tail = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+            log(f"section {name}: ok in {dt:.0f}s — {tail[:300]}")
+        time.sleep(30)  # brief settle, then re-check what's still pending
+
+
+if __name__ == "__main__":
+    sys.exit(main())
