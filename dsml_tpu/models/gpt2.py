@@ -1026,7 +1026,9 @@ class GPT2:
         scalar position) and ``decode_step_slots`` (per-slot position
         vector) differ ONLY in positions/valid/write; ``prefill_chunk``
         additionally passes ``read_index`` (the chunk-local position whose
-        logits to return — decode's single query reads index 0)."""
+        logits to return — decode's single query reads index 0), and
+        ``verify_step`` passes ``read_index="all"`` for per-position
+        logits [b, C, vocab]."""
         tp_size = lax.axis_size(tp_axis) if tp_axis else 1
         new_cache = []
         for layer, c in zip(params["layers"], cache):
@@ -1042,7 +1044,9 @@ class GPT2:
             h = self._ffn(layer, h, tp_axis)
             new_cache.append(c)
         h = self._final_norm(params, h)
-        if read_index is None:
+        if isinstance(read_index, str) and read_index == "all":
+            h_last = h  # [b, C, d] → logits at every query position
+        elif read_index is None:
             h_last = h[:, 0]
         else:
             h_last = lax.dynamic_index_in_dim(
@@ -1088,6 +1092,43 @@ class GPT2:
             params, cache, h, positions, valid,
             lambda arr, new: arr.at[bidx, :, pos, :].set(new[:, :, 0, :]),
             tp_axis,
+        )
+
+    def verify_step(
+        self, params: dict, cache: list, tokens: jax.Array, start,
+        tp_axis: str | None = None,
+    ):
+        """Multi-query decode for SPECULATIVE verification: ``tokens``
+        [b, C] (each row: its last accepted token followed by C−1 draft
+        tokens) run at per-row positions ``start[b]..start[b]+C-1``
+        against the cache, writing their K/V rows and returning logits at
+        EVERY position — (logits [b, C, vocab], cache).
+
+        One call scores all C candidate continuations of every row (the
+        verify half of speculative decoding — ``models.speculative``);
+        rows sit at independent depths, so the write is a per-row
+        ``dynamic_update_slice`` (vmapped → batched scatter) and the mask
+        admits ``s <= start[b]+i`` per query. Rejected drafts leave
+        garbage K/V rows beyond the accepted prefix; the NEXT verify
+        window starts at the first garbage row and is at least as long,
+        so every garbage row is overwritten before any query can attend
+        to it (same argument as bucketed prefill's pad rows)."""
+        cfg = self.config
+        _, c = tokens.shape
+        start = jnp.asarray(start, jnp.int32)  # [b]
+        positions = start[:, None] + jnp.arange(c, dtype=jnp.int32)  # [b, C]
+        h = self._embed_spmd(params, tokens, tp_axis, seq_offset=start[:, None])
+        valid = (
+            jnp.arange(cfg.max_seq)[None, None, :] <= positions[:, :, None]
+        )  # [b, C, S]
+
+        def write(arr, new):  # arr [b, H, S, x], new [b, H, C, x]
+            return jax.vmap(
+                lambda a, nw, p: lax.dynamic_update_slice(a, nw, (0, p, 0))
+            )(arr, new, start)
+
+        return self._decode_core(
+            params, cache, h, positions, valid, write, tp_axis, read_index="all"
         )
 
     def prefill_chunk(

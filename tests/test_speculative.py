@@ -1,0 +1,109 @@
+"""Speculative decoding (prompt-lookup drafts + multi-query verify).
+
+The whole point is a THROUGHPUT transform with a token-level identity
+guarantee: greedy speculative output must equal plain greedy ``generate``
+exactly — acceptance only changes how many verify calls it takes, never
+the tokens. Every test here pins that identity across families, window
+sizes, batch, and the int8 KV cache; call counts pin that the machinery
+actually accepts drafts (and never exceeds the 1-token/call floor).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+from dsml_tpu.models.llama import Llama, LlamaConfig
+from dsml_tpu.models.speculative import generate_speculative
+
+
+def _rep_prompt(cfg, block=8, reps=4, seed=0):
+    """Lookup-friendly prompt: a block repeated — n-gram matches abound."""
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        np.tile(rng.integers(0, cfg.vocab_size, (block,)), reps)[None, :], jnp.int32
+    )
+
+
+def _rand_prompt(cfg, batch=2, t=20, seed=1):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, t)), jnp.int32)
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_speculative_equals_greedy_generate(family):
+    model = (
+        GPT2(GPT2Config.tiny()) if family == "gpt2" else Llama(LlamaConfig.tiny())
+    )
+    cfg = model.config
+    params = model.init(0)
+    max_new = 24
+    for prompt in (_rep_prompt(cfg), _rand_prompt(cfg)):
+        ref = np.asarray(model.generate(params, prompt, max_new))
+        got, calls = generate_speculative(
+            model, params, prompt, max_new, window=6, return_calls=True
+        )
+        np.testing.assert_array_equal(np.asarray(got), ref)
+        # the guaranteed floor: >= 1 committed token per verify call
+        assert calls <= max_new
+
+
+@pytest.mark.slow
+def test_speculative_window_sizes():
+    """Window extremes: 2 (one draft — degenerate speculative) and 8 both
+    preserve the greedy identity; the default run keeps window 6."""
+    model = GPT2(GPT2Config.tiny())
+    params = model.init(0)
+    prompt = _rep_prompt(model.config)
+    max_new = 20
+    ref = np.asarray(model.generate(params, prompt, max_new))
+    for window in (2, 8):
+        got = generate_speculative(model, params, prompt, max_new, window=window)
+        np.testing.assert_array_equal(np.asarray(got), ref, err_msg=str(window))
+
+
+def test_speculative_actually_accepts_drafts():
+    """On a lookup-friendly stream the verify calls must come in well
+    under one-per-token — otherwise the module is a slow greedy decoder.
+    (Random-init GPT-2 greedy output is degenerate/repetitive, which is
+    exactly the regime prompt lookup exploits; fixed seeds make the count
+    deterministic.)"""
+    model = GPT2(GPT2Config.tiny())
+    params = model.init(0)
+    prompt = _rep_prompt(model.config)
+    max_new = 24
+    got, calls = generate_speculative(
+        model, params, prompt, max_new, window=6, return_calls=True
+    )
+    assert got.shape == (1, max_new)
+    assert calls < max_new, f"no drafts accepted in {calls} calls"
+
+
+def test_speculative_with_kv_quant():
+    """Speculative verify writes int8 cache rows through the same
+    _cache_write path; tokens still equal the quantized greedy decode."""
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), kv_quant=True))
+    params = model.init(0)
+    prompt = _rep_prompt(model.config)
+    ref = np.asarray(model.generate(params, prompt, 20))
+    got = generate_speculative(model, params, prompt, 20, window=6)
+    np.testing.assert_array_equal(np.asarray(got), ref)
+
+
+def test_speculative_validation():
+    model = GPT2(GPT2Config.tiny())
+    params = model.init(0)
+    prompt = _rand_prompt(model.config, batch=1, t=8)
+    with pytest.raises(ValueError, match="window"):
+        generate_speculative(model, params, prompt, 4, window=1)
+    with pytest.raises(ValueError, match="ngram"):
+        generate_speculative(model, params, prompt, 4, ngram=9)
+    with pytest.raises(ValueError, match="fit max_seq"):
+        generate_speculative(
+            model, params, prompt, model.config.max_seq - 8, window=8
+        )
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate_speculative(model, params, prompt, 0)
