@@ -174,6 +174,7 @@ def _tiny_plan_setup(hbm_bytes):
     return cfg, model, optax.adam(1e-3), plan, n_params
 
 
+@pytest.mark.slow
 def test_planner_emitted_fsdp_mesh_trains_with_sharded_memory(devices8):
     """VERDICT r2 item 2 done-criterion: a planner-emitted fsdp(+dp) mesh
     trains through the HYBRID step with per-chip param bytes ≈ 1/fsdp of the

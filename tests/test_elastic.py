@@ -254,6 +254,7 @@ def test_elastic_restack_for_new_pipeline(devices8, monkeypatch):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_elastic_replan_onto_planner_emitted_pipeline(devices8):
     """The re-plan path to a PIPELINE mesh driven by the capacity rules
     themselves (no monkeypatch): with a planner_overrides hbm_bytes so small
@@ -287,6 +288,7 @@ def test_elastic_replan_onto_planner_emitted_pipeline(devices8):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_elastic_is_model_generic_llama(devices8):
     """reconfigure works for the Llama family too (param_specs/n_params are
     the only model hooks it uses — the model-generic claim)."""

@@ -11,6 +11,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "examples"))
 
 
+@pytest.mark.slow
 def test_gpt2_example_trains_and_loss_drops():
     import train_gpt2
 
@@ -52,6 +53,7 @@ def test_cifar_example_synthetic_fallback(tmp_path):
     assert data.train_x.shape[1:] == (32, 32, 3)
 
 
+@pytest.mark.slow
 def test_llama_family_example_trains():
     import train_gpt2
 

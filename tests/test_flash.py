@@ -138,6 +138,7 @@ def test_ring_flash_gradients_match_full(mesh8):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_gpt2_ring_flash_loss_matches_ring(devices8):
     from jax import lax
     from jax.sharding import PartitionSpec as P

@@ -122,6 +122,7 @@ def test_hybrid_fsdp_matches_pure_dp(devices8):
     np.testing.assert_allclose(got, ref, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_hybrid_fsdp_composes_with_pipeline_gpipe(devices8):
     """pp × fsdp × tp in one step (gpipe): the full five-axis composition —
     and the 1F1B schedule refuses fsdp > 1 loudly instead of silently
@@ -191,6 +192,7 @@ def test_fsdp_llama_hybrid_matches_pure_dp(devices8):
     )
 
 
+@pytest.mark.slow
 def test_fsdp_llama_trains_sharded(devices8):
     """FSDP is model-generic: the Llama family trains with ZeRO-style
     sharding-annotated params (loss uses the plain single-device math;

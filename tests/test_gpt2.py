@@ -404,6 +404,7 @@ def test_pp_interleaved_hybrid_matches_single_device(pp_mesh8):
         make_hybrid_train_step(model, optimizer, pp_mesh8, schedule="1f1b")
 
 
+@pytest.mark.slow
 def test_pp_hybrid_train_step_converges(pp_mesh8):
     cfg = GPT2Config.tiny()
     model = GPT2(cfg)
@@ -482,6 +483,7 @@ def test_remat_gradients_identical(hybrid_mesh):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_int8_remat_gradients_close(hybrid_mesh):
     """Compressed remat (remat="int8", the ActNN/GACT capability): the stash
     is quantized, so grads are approximate — but bounded by the quantization
@@ -529,6 +531,7 @@ def test_int8_remat_gradients_close(hybrid_mesh):
         assert np.abs(a - b).max() / denom < 0.1, np.abs(a - b).max() / denom
 
 
+@pytest.mark.slow
 def test_bfloat16_hybrid_training_converges(hybrid_mesh):
     """bf16 params/activations (the TPU MXU-native dtype) through the full
     hybrid step: loss finite and decreasing; f32 loss accumulation inside."""

@@ -9,6 +9,7 @@ from dsml_tpu.trainer import TrainConfig, Trainer
 from dsml_tpu.utils.data import synthetic_classification
 
 
+@pytest.mark.slow
 def test_cnn_trains_dp(dp_mesh8):
     # real MNIST subset: convs need spatial structure synthetic data lacks
     from dsml_tpu.utils.data import Dataset, load_mnist

@@ -150,12 +150,34 @@ def test_decode_quantum_does_not_change_tokens():
         out = srv.run()
         return [out[r] for r in rids]
 
+    a, b = serve(1, 0.0), serve(4, 0.0)
+    assert a == b
+    # greedy quantum path still equals standalone generate
+    for tokens, p in zip(b, prompts):
+        assert tokens == _reference(model, params, p, 7)
+
+
+@pytest.mark.slow
+def test_decode_quantum_full_matrix():
+    """The full quantum × temperature matrix (the default run keeps the
+    greedy 1-vs-4 representative): sampled tokens are also quantum-
+    independent, including quantum 8 > every request's budget."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(6)
+    prompts = _prompts(cfg, [5, 12, 8], seed=6)
+
+    def serve(quantum, temperature):
+        srv = ContinuousBatcher(model, params, n_slots=2, temperature=temperature,
+                                seed=9, prompt_buckets=(8, 16),
+                                decode_quantum=quantum)
+        rids = [srv.submit(p, 7) for p in prompts]
+        out = srv.run()
+        return [out[r] for r in rids]
+
     for temp in (0.0, 0.9):
         a, b, c = serve(1, temp), serve(4, temp), serve(8, temp)
         assert a == b == c, temp
-    # greedy quantum path still equals standalone generate
-    for tokens, p in zip(serve(4, 0.0), prompts):
-        assert tokens == _reference(model, params, p, 7)
 
 
 def test_tp_sharded_batcher_matches_single_device(devices8):
@@ -184,6 +206,7 @@ def test_tp_sharded_batcher_matches_single_device(devices8):
     assert shard.data.shape[1] == cfg.n_head // 2
 
 
+@pytest.mark.slow
 def test_tp_sharded_batcher_llama_kv_quant(devices8):
     """The full serving composition: Llama GQA + int8 KV cache + TP sharding
     + continuous batching, tokens equal the single-device quantized batcher."""
@@ -293,12 +316,35 @@ def test_chunked_prefill_admission_matches_generate():
         out = srv.run()
         return [out[r] for r in rids]
 
-    assert serve(16, 0.0) == serve(0, 0.0)
-    assert serve(16, 0.8) == serve(0, 0.8)
-    for tokens, p, n in zip(serve(16, 0.0), prompts, budgets):
+    chunked = serve(16, 0.0)
+    assert chunked == serve(0, 0.0)
+    for tokens, p, n in zip(chunked, prompts, budgets):
         assert tokens == _reference(model, params, p, n)
 
 
+@pytest.mark.slow
+def test_chunked_prefill_admission_matches_sampled():
+    """Sampled (temperature) tokens are also chunk-independent — the
+    rid-derived keys don't see the admission schedule. (Default run keeps
+    the greedy representative above.)"""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(13)
+    prompts = _prompts(cfg, [5, 30, 17, 58, 9], seed=13)
+    budgets = [6, 4, 8, 3, 5]
+
+    def serve(chunk):
+        srv = ContinuousBatcher(model, params, n_slots=2, temperature=0.8,
+                                seed=13, prompt_buckets=(8, 16, 32, 64),
+                                prefill_chunk=chunk)
+        rids = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+        out = srv.run()
+        return [out[r] for r in rids]
+
+    assert serve(16) == serve(0)
+
+
+@pytest.mark.slow
 def test_chunked_prefill_admission_matches_generate_llama():
     """The chunked path is model-generic (RoPE positions and the GQA int8
     cache follow the chunk's global offsets)."""
