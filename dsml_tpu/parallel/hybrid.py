@@ -117,11 +117,6 @@ def make_hybrid_train_step(
         raise ValueError("schedule='1f1b' requires a mesh with pp > 1")
     if schedule == "1f1b" and getattr(model.config, "pp_interleave", 1) > 1:
         raise ValueError("pp_interleave > 1 composes with the gpipe schedule only")
-    if schedule == "1f1b" and fsdp_size > 1:
-        # 1F1B differentiates per-tick INSIDE shard_map; composing the
-        # gather/reduce-scatter with that seed arithmetic is unbuilt — fail
-        # loudly rather than train on silently-replicated params
-        raise ValueError("fsdp > 1 composes with the gpipe schedule only")
     pspecs = model.param_specs(pp=bool(pp_axis), fsdp=fsdp_size)
     # fsdp doubles as a data axis (ZeRO): batch rows shard over dp × fsdp
     batch_spec = P(("dp", "fsdp"), "sp")
@@ -164,13 +159,25 @@ def make_hybrid_train_step(
         # their exact cotangents, and the transpose of each auto-lifted
         # replicated input psums its cotangent across the lifted axes right
         # inside the per-tick vjp. With the schedule's seed carrying the
-        # 1/(M·n_dp·n_sp) normalization, grads therefore arrive already
-        # reduced to each leaf's replication — no further psums here.
-        loss, grads = model.train_grads_1f1b_spmd(
-            params, x, y, tp_axis="tp", sp_axis="sp", attn_impl=attn_impl,
+        # 1/(M·n_dp·n_fsdp·n_sp) normalization, grads therefore arrive
+        # already reduced to each leaf's replication — no further psums.
+        #
+        # fsdp composes through an EXPLICIT vjp of the weight gather: the
+        # schedule sees full weights (marked fsdp-varying, so its per-tick
+        # transposes leave their cotangents per-rank), and pulling the
+        # accumulated full-weight grads back through the gather's transpose
+        # is one psum_scatter per sharded leaf — summing the fsdp data
+        # ranks AND scattering into shard layout, exactly ZeRO's backward
+        # half (the same collective the gpipe path gets from shard_map's
+        # outer-grad transpose). Leaves without an fsdp dim pass through
+        # untouched and their fsdp reduction happens via the schedule's
+        # auto-lift psums like any replicated param.
+        full, fsdp_vjp = jax.vjp(lambda p: gather_fsdp(p, pspecs), params)
+        loss, grads_full = model.train_grads_1f1b_spmd(
+            full, x, y, tp_axis="tp", sp_axis="sp", attn_impl=attn_impl,
             pp_axis="pp", n_micro=n_microbatches,
             # the batch enters P(('dp','fsdp'),'sp'): data varies over fsdp
-            # too (size 1 on 1F1B meshes, but vma tracking still sees it)
+            # too (size 1 on fsdp-less meshes, but vma tracking still sees it)
             batch_axes=("dp", "fsdp", "sp"),
         )
         # loss is masked to the last pp rank; batch axes hold genuinely
@@ -180,6 +187,7 @@ def make_hybrid_train_step(
         rest = tuple(jax.typeof(loss).vma)
         if rest:
             loss = lax.pmean(loss, rest)
+        (grads,) = fsdp_vjp(grads_full)
         return loss, grads
 
     if pp_axis and schedule == "1f1b":

@@ -1,0 +1,98 @@
+"""Speculative decoding demo: prompt-lookup drafts + multi-query verify.
+
+Runs greedy decode twice on the same prompt — plain ``generate`` and
+``models.speculative.generate_speculative`` — asserts the tokens are
+IDENTICAL, and reports wall-clock plus the acceptance diagnostic (tokens
+per verify call; plain greedy is exactly 1.0 per model call).
+
+The demo prompt repeats a block, the regime prompt lookup exploits
+(summarization/code/chat reusing earlier spans). Random-init models also
+emit degenerate repetitive text, so acceptance is visible even at tiny
+scale.
+
+Run (CPU): python examples/decode_speculative.py --platform cpu
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")  # repo-root invocation
+
+from dsml_tpu.utils.config import Config, field
+from dsml_tpu.utils.logging import get_logger
+
+log = get_logger("spec")
+
+
+@dataclasses.dataclass
+class SpecConfig(Config):
+    platform: str = field("", help="jax platform override: cpu|tpu ('' = default)")
+    cpu_devices: int = field(0, help="virtual CPU device count for --platform cpu")
+    family: str = field("gpt2", help="model family: gpt2 | llama")
+    model: str = field("tiny", help="model preset (tiny for the demo)")
+    batch: int = field(2, help="rows decoded together")
+    prompt_len: int = field(32, help="prompt tokens (a repeated block)")
+    max_new: int = field(48, help="tokens to generate")
+    window: int = field(6, help="tokens scored per verify call (1 + drafts)")
+    ngram: int = field(2, help="lookup n-gram length")
+    seed: int = field(0, help="workload seed")
+
+
+def main() -> None:
+    cfg = SpecConfig.parse_args()
+    if cfg.platform:
+        from dsml_tpu.utils.platform import configure_platform
+
+        configure_platform(cfg.platform, cfg.cpu_devices or None)
+
+    import jax.numpy as jnp
+
+    from dsml_tpu.models import model_by_family
+    from dsml_tpu.models.speculative import generate_speculative
+
+    model, mcfg = model_by_family(cfg.family, cfg.model)
+    params = model.init(cfg.seed)
+    rng = np.random.default_rng(cfg.seed)
+    block = rng.integers(0, mcfg.vocab_size, (max(cfg.prompt_len // 4, cfg.ngram),))
+    prompt = jnp.asarray(
+        np.tile(block, 4)[: cfg.prompt_len][None, :].repeat(cfg.batch, 0), jnp.int32
+    )
+
+    def timed(fn):
+        np.asarray(fn())  # compile + sync
+        t0 = time.monotonic()
+        out = np.asarray(fn())
+        return out, time.monotonic() - t0
+
+    ref, greedy_s = timed(lambda: model.generate(params, prompt, cfg.max_new))
+    spec, spec_s = timed(
+        lambda: generate_speculative(
+            model, params, prompt, cfg.max_new, window=cfg.window, ngram=cfg.ngram
+        )
+    )
+    _, calls = generate_speculative(
+        model, params, prompt, cfg.max_new, window=cfg.window, ngram=cfg.ngram,
+        return_calls=True,
+    )
+    assert np.array_equal(ref, spec), "speculative output diverged from greedy!"
+    total = cfg.batch * cfg.max_new
+    log.info("tokens identical to greedy generate: OK (%d tokens x %d rows)",
+             cfg.max_new, cfg.batch)
+    log.info("greedy     : %.3fs  (%.1f tok/s, 1.00 tokens/model-call)",
+             greedy_s, total / greedy_s)
+    log.info("speculative: %.3fs  (%.1f tok/s, %.2f tokens/verify-call, %d calls)",
+             spec_s, total / spec_s, cfg.max_new / max(calls, 1), calls)
+    log.info(
+        "acceptance is workload-dependent: repetitive/structured text drafts "
+        "well; the win materializes where decode is HBM-bound (big models on "
+        "TPU) — at toy scale the verify window's extra compute can outweigh it"
+    )
+
+
+if __name__ == "__main__":
+    main()

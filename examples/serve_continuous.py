@@ -36,6 +36,9 @@ class ServeConfig(Config):
     n_slots: int = field(4, help="decode slots (concurrent requests)")
     quantum: int = field(1, help="tokens decoded per scheduler tick (one jitted "
                          "scan; amortizes the per-tick host round trip)")
+    prefill_chunk: int = field(0, help="chunked-prefill admission: prefill C "
+                               "tokens per tick with decode quanta between a "
+                               "long prompt's chunks (0 = whole-prompt)")
     requests: int = field(12, help="number of requests in the workload")
     max_new_max: int = field(24, help="largest per-request token budget")
     temperature: float = field(0.0, help="0 = greedy")
@@ -70,6 +73,7 @@ def main() -> None:
     srv = ContinuousBatcher(
         model, params, n_slots=cfg.n_slots, temperature=cfg.temperature,
         seed=cfg.seed, prompt_buckets=(16, 32, 64), decode_quantum=cfg.quantum,
+        prefill_chunk=cfg.prefill_chunk,
     )
     # warmup pass: compile every bucket's prefill + the decode program so
     # the timed pass measures steady-state serving, not compilation

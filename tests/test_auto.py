@@ -267,14 +267,12 @@ def test_planner_emitted_pipeline_trains_gpipe_and_1f1b(devices8):
 
     for schedule in ("gpipe", "1f1b"):
         opt = optax.adam(1e-3)
-        # 1f1b composes with fsdp=1 only: re-plan the non-fsdp axes onto a
-        # pure pp×tp submesh for that schedule
-        spec = plan.spec if schedule == "gpipe" else dc.replace(
-            plan.spec, fsdp=1, dp=plan.spec.dp * plan.spec.fsdp
+        # BOTH schedules train on the planner's mesh as emitted — including
+        # its fsdp axis (1F1B × fsdp composes via the vjp-of-gather path)
+        step = make_hybrid_train_step(
+            model, opt, mesh, n_microbatches=2, schedule=schedule
         )
-        m = build_mesh(spec, devices8)
-        step = make_hybrid_train_step(model, opt, m, n_microbatches=2, schedule=schedule)
-        params, ostate = init_hybrid(model, opt, m, seed=0)
+        params, ostate = init_hybrid(model, opt, mesh, seed=0)
         losses = []
         for _ in range(3):
             params, ostate, loss = step(params, ostate, x, y)
