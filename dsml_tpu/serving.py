@@ -56,6 +56,28 @@ def _bucket(n: int, buckets: tuple) -> int:
     raise ValueError(f"prompt length {n} exceeds the largest bucket {buckets[-1]}")
 
 
+def _lookup_draft(history: np.ndarray, n: int, k: int) -> np.ndarray:
+    """Prompt-lookup draft: the k tokens that followed the MOST RECENT
+    prior occurrence of ``history``'s trailing n-gram; repeats the last
+    token when no match exists (acceptance then falls to the guaranteed
+    +1-token/tick floor — wrong drafts only cost speed, never tokens)."""
+    length = len(history)
+    n = min(n, length)
+    gram = history[length - n:]
+    win = np.lib.stride_tricks.sliding_window_view(history, n)  # [L-n+1, n]
+    # exclude only the trailing gram itself (windows ending before the last
+    # position; overlap with the gram region is allowed) — the same rule as
+    # the device-side lookup in models/speculative.py (j + n - 1 < pos)
+    matches = np.flatnonzero(np.all(win[: length - n] == gram, axis=1))
+    if len(matches) == 0:
+        return np.full(k, history[-1], np.int32)
+    best = int(matches[-1])
+    src = history[best + n : best + n + k].astype(np.int32)
+    if len(src) < k:  # match near the end: pad with last-token repeats
+        src = np.concatenate([src, np.full(k - len(src), history[-1], np.int32)])
+    return src
+
+
 class ContinuousBatcher:
     """Slot-based continuous-batching decoder over one model + params.
 
@@ -85,6 +107,20 @@ class ContinuousBatcher:
     in tests; with ``kv_quant`` the chunk path reads int8 cache rows for
     within-prompt attention, the standard chunked-prefill approximation).
     0 (default) keeps whole-prompt bucketed admission.
+
+    ``speculative_window`` — when >= 2, each decode tick runs PROMPT-LOOKUP
+    SPECULATIVE decoding across all slots: every active slot drafts
+    window−1 tokens from the most recent n-gram match in its own history
+    (host-side numpy — no device round trip), ONE ``model.verify_step``
+    call scores every slot's window at its own depth, and each slot
+    commits the longest draft prefix matching the model's greedy chain
+    plus the model's own next token — 1..window tokens per tick per slot.
+    Greedy only (``temperature`` must be 0) and exclusive with
+    ``decode_quantum > 1`` (the window IS the quantum). Tokens are
+    identical to the plain batcher and to ``generate`` (pinned in tests);
+    rejected drafts leave garbage cache rows that the next verify window
+    always overwrites before any query attends to them
+    (``verify_step``'s invariant).
     """
 
     def __init__(
@@ -98,6 +134,8 @@ class ContinuousBatcher:
         prompt_buckets: tuple = (32, 64, 128, 256, 512, 1024),
         decode_quantum: int = 1,
         prefill_chunk: int = 0,
+        speculative_window: int = 0,
+        speculative_ngram: int = 2,
         mesh=None,
     ):
         """``mesh`` — a framework mesh (``parallel.mesh.build_mesh``) makes
@@ -143,6 +181,25 @@ class ContinuousBatcher:
         if decode_quantum < 1:
             raise ValueError(f"decode_quantum must be >= 1, got {decode_quantum}")
         self.decode_quantum = decode_quantum
+        if speculative_window:
+            if speculative_window < 2 or speculative_ngram < 1:
+                raise ValueError(
+                    f"speculative_window must be >= 2 (1 committed + >=1 draft) "
+                    f"and speculative_ngram >= 1; got {speculative_window}, "
+                    f"{speculative_ngram}"
+                )
+            if self.temperature > 0.0:
+                raise ValueError(
+                    "speculative decoding is greedy-only (verify-by-argmax); "
+                    "temperature must be 0"
+                )
+            if decode_quantum != 1:
+                raise ValueError(
+                    "speculative_window replaces decode_quantum (the window IS "
+                    "the per-tick token budget); set decode_quantum=1"
+                )
+        self.speculative_window = int(speculative_window)
+        self.speculative_ngram = int(speculative_ngram)
         max_seq = cfg.max_seq
         temperature = self.temperature
         tp_axis = "tp" if mesh is not None else None
@@ -180,6 +237,9 @@ class ContinuousBatcher:
         def prefill_chunk_fn(p, c, toks, start, last):
             return model.prefill_chunk(p, c, toks, start, tp_axis, last_index=last)
 
+        def verify_fn(p, c, toks, pos):  # toks [B, W], pos [B] per-slot depth
+            return model.verify_step(p, c, toks, pos, tp_axis)
+
         if mesh is None:
             self.params = params
             self._cache = model.init_cache(n_slots)
@@ -193,6 +253,7 @@ class ContinuousBatcher:
             self._prefill = jax.jit(prefill_fn)
             # ONE compile serves every chunk: start/last_index stay traced
             self._prefill_chunk = jax.jit(prefill_chunk_fn, donate_argnums=(1,))
+            self._verify = jax.jit(verify_fn, donate_argnums=(1,))
             self._fresh_cache1 = lambda: model.init_cache(1)
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -241,6 +302,15 @@ class ContinuousBatcher:
                 ),
                 donate_argnums=(1,),
             )
+            self._verify = jax.jit(
+                jax.shard_map(
+                    verify_fn, mesh=mesh,
+                    in_specs=(pspecs, cache_spec, P(), P()),
+                    out_specs=(P(), cache_spec),
+                    check_vma=False,
+                ),
+                donate_argnums=(1,),
+            )
             self._fresh_cache1 = lambda: jax.tree.map(
                 lambda a: jax.device_put(a, head_sh), model.init_cache(1)
             )
@@ -268,6 +338,16 @@ class ContinuousBatcher:
         self.model._check_generate_args(
             len(prompt), max_new_tokens, self.temperature, 0, 0.0
         )
+        if self.speculative_window:
+            # a continuing slot verifies a full window at pos < L + max_new;
+            # its last row (pos + W - 1) must stay inside the cache
+            w = self.speculative_window
+            if len(prompt) + max_new_tokens + w - 1 > self.model.config.max_seq:
+                raise ValueError(
+                    f"prompt ({len(prompt)}) + max_new ({max_new_tokens}) + "
+                    f"speculative_window-1 ({w - 1}) must fit max_seq="
+                    f"{self.model.config.max_seq}"
+                )
         if not self._chunk_grid_fits(len(prompt)):
             # whole-prompt bucketed admission → reject at submit, not admit
             _bucket(len(prompt), self.prompt_buckets)
@@ -436,6 +516,8 @@ class ContinuousBatcher:
         active = np.flatnonzero(self._slot_rid >= 0)
         if len(active) == 0:
             return emitted
+        if self.speculative_window:
+            return self._step_speculative(emitted, active)
         steps_done = np.asarray(
             [len(self._live[rid].tokens) if rid >= 0 else 0 for rid in self._slot_rid],
             np.int32,
@@ -473,6 +555,57 @@ class ContinuousBatcher:
                     " have diverged"
                 )
                 self._last_tok[slot] = int(toks[-1, slot])
+        return emitted
+
+    def _step_speculative(self, emitted: dict, active) -> dict[int, list]:
+        """One speculative tick: per-slot prompt-lookup drafts (host-side),
+        ONE ``verify_step`` call over all slots at their own depths, then
+        per-slot greedy-chain acceptance — each active slot commits
+        1..window tokens. Inactive slots carry a dummy window at position 0
+        whose garbage cache rows the admission insert fully overwrites."""
+        w = self.speculative_window
+        toks = np.zeros((self.n_slots, w), np.int32)
+        pos = np.zeros(self.n_slots, np.int32)
+        for slot in active:
+            req = self._live[int(self._slot_rid[slot])]
+            history = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)]
+            )
+            toks[slot, 0] = self._last_tok[slot]
+            toks[slot, 1:] = _lookup_draft(history, self.speculative_ngram, w - 1)
+            pos[slot] = self._pos[slot]
+        logits, self._cache = self._verify(
+            self.params, self._cache, jnp.asarray(toks), jnp.asarray(pos)
+        )
+        greedy = np.asarray(jnp.argmax(logits, axis=-1))  # [n_slots, W]
+        for slot in active:
+            req = self._live[int(self._slot_rid[slot])]
+            new = emitted.setdefault(req.rid, [])
+            drafts = toks[slot, 1:]
+            committed = 0
+            for i in range(w):
+                # greedy[i] is the model's next token after consuming window
+                # position i — valid iff every draft before it matched the
+                # chain, which is exactly how far this loop gets
+                tok = int(greedy[slot, i])
+                req.tokens.append(tok)
+                new.append(tok)
+                self._last_tok[slot] = tok
+                committed += 1
+                if self._finished(req, tok):
+                    self._retire(req)
+                    self._slot_rid[slot] = -1  # freed → next admit reuses it
+                    break
+                if i == w - 1 or int(drafts[i]) != tok:
+                    break  # draft diverged (or window exhausted): stop here
+            if self._slot_rid[slot] >= 0:  # request continues
+                self._pos[slot] += committed
+                # the next verify window writes rows pos..pos+W-1; submit()'s
+                # L + max_new + W - 1 <= max_seq budget keeps it in range
+                assert self._pos[slot] + w <= self.model.config.max_seq, (
+                    f"slot {slot} verify window would escape max_seq="
+                    f"{self.model.config.max_seq}"
+                )
         return emitted
 
     def collect(self) -> dict[int, list]:

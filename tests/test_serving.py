@@ -423,6 +423,86 @@ def test_prompt_buckets_sorted_and_deduped():
     assert srv.prompt_buckets == (8, 32, 64)
 
 
+def test_speculative_batcher_matches_plain_and_generate():
+    """speculative_window is pure throughput: per-slot prompt-lookup
+    drafts + one multi-query verify per tick commit EXACTLY the tokens
+    the plain batcher (and standalone generate) produce — across
+    staggered arrivals, mid-window EOS retirement, and composition with
+    chunked-prefill admission."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(17)
+    prompts = _prompts(cfg, [5, 17, 32, 9, 26], seed=17)
+    budgets = [6, 3, 8, 5, 4]
+
+    def serve(**kw):
+        srv = ContinuousBatcher(model, params, n_slots=2,
+                                prompt_buckets=(8, 16, 32), **kw)
+        rids = [srv.submit(p, n) for p, n in zip(prompts[:3], budgets[:3])]
+        srv.step()
+        rids += [srv.submit(p, n) for p, n in zip(prompts[3:], budgets[3:])]
+        out = srv.run()
+        return [out[r] for r in rids]
+
+    plain = serve()
+    assert serve(speculative_window=5) == plain
+    assert serve(speculative_window=5, prefill_chunk=8) == plain
+    for tokens, p, n in zip(plain, prompts, budgets):
+        assert tokens == _reference(model, params, p, n)
+
+    # EOS retirement mid-window: a slot must stop AT the eos even when the
+    # verify window would have committed more
+    ref = _reference(model, params, prompts[0], 6)
+    eos = ref[2]
+    srv = ContinuousBatcher(model, params, n_slots=1, eos_id=eos,
+                            prompt_buckets=(8,), speculative_window=5)
+    rid = srv.submit(prompts[0], 6)
+    out = srv.run()
+    assert out[rid] == ref[: ref.index(eos) + 1]
+
+
+def test_speculative_batcher_validation():
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(0)
+    with pytest.raises(ValueError, match="greedy-only"):
+        ContinuousBatcher(model, params, temperature=0.5, speculative_window=4)
+    with pytest.raises(ValueError, match="decode_quantum"):
+        ContinuousBatcher(model, params, decode_quantum=2, speculative_window=4)
+    with pytest.raises(ValueError, match="speculative_window"):
+        ContinuousBatcher(model, params, speculative_window=1)
+    srv = ContinuousBatcher(model, params, speculative_window=8)
+    with pytest.raises(ValueError, match="speculative_window"):
+        # window rows of a just-finishing request would escape the cache
+        srv.submit(np.zeros(64, np.int32), cfg.max_seq - 64 - 2)
+
+
+@pytest.mark.slow
+def test_speculative_batcher_llama_and_tp(devices8):
+    """Speculative serving is model-generic (Llama GQA + RoPE at per-slot
+    window offsets) and TP composes (shard_map verify with the
+    head-sharded cache) — tokens equal the plain batcher."""
+    from dsml_tpu.models.llama import Llama, LlamaConfig
+    from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    model = Llama(LlamaConfig.tiny())
+    cfg = model.config
+    params = model.init(18)
+    prompts = _prompts(cfg, [7, 21, 12], seed=18)
+
+    def serve(**kw):
+        srv = ContinuousBatcher(model, params, n_slots=2,
+                                prompt_buckets=(8, 32), **kw)
+        rids = [srv.submit(p, 6) for p in prompts]
+        out = srv.run()
+        return [out[r] for r in rids]
+
+    plain = serve()
+    assert serve(speculative_window=4) == plain
+    mesh = build_mesh(MeshSpec(tp=2), devices8[:2])
+    assert serve(speculative_window=4, mesh=mesh) == plain
+
+
 def test_submit_validation():
     cfg = GPT2Config.tiny()
     model = GPT2(cfg)
