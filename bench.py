@@ -431,6 +431,7 @@ def bench_serving() -> dict:
     srv.submit(rng.integers(0, cfg.vocab_size, (prompt_hi,)).astype(np.int32), 2)
     srv.run()
     srv.collect()
+    srv.reset_latency_stats()  # warmup requests must not skew the percentiles
 
     # timed: streaming arrivals — a third of the requests queue up front
     # (a burst), the rest arrive 2 per tick (Poisson-ish steady stream)
@@ -448,6 +449,9 @@ def bench_serving() -> dict:
     cont_wall = time.monotonic() - t0
     total_tokens = sum(len(t) for t in out.values())
     assert len(out) == n_requests
+    # online-serving latency percentiles over the timed streaming workload
+    # (warmup requests excluded via the reset)
+    latency = srv.latency_stats()
 
     # static baseline on the SAME workload: pad every prompt to the longest,
     # one generate per slot-sized batch, everyone waits for the longest
@@ -481,6 +485,12 @@ def bench_serving() -> dict:
         "serving_slots": n_slots,
         "serving_decode_quantum": quantum,
         "serving_prefill_chunk": chunk,
+        "serving_ttft_p50_ms": round(latency.get("ttft_p50_s", 0) * 1e3, 1),
+        "serving_ttft_p99_ms": round(latency.get("ttft_p99_s", 0) * 1e3, 1),
+        # per-EMISSION gaps (one emission = one decode quantum of tokens)
+        "serving_gap_p50_ms": round(latency.get("gap_p50_s", 0) * 1e3, 2),
+        "serving_gap_p99_ms": round(latency.get("gap_p99_s", 0) * 1e3, 2),
+        "serving_e2e_p99_ms": round(latency.get("e2e_p99_s", 0) * 1e3, 1),
         "serving_model": (
             f"GPT2 L{cfg.n_layer} d{cfg.d_model} max_seq{cfg.max_seq} {cfg.dtype}"
         ),
@@ -1179,19 +1189,21 @@ def main() -> None:
             extras.update(bench_gpt2_realtext())
         except Exception as e:
             errors["gpt2_realtext"] = repr(e)[:300]
-    # serving rows (continuous batcher vs static, Llama GQA+int8-kv decode):
-    # run on every backend — CPU fallback sizes itself down and the
-    # provenance label carries the no-signal caveat
-    if not _skip_for_budget(extras, "serving", 240):
-        try:
-            extras.update(bench_serving())
-        except Exception as e:
-            errors["serving"] = repr(e)[:300]
+    # allreduce first: it is the SECOND BASELINE metric — the beyond-
+    # reference serving rows must not budget-starve it
     if not _skip_for_budget(extras, "allreduce", 90):
         try:
             extras.update(bench_ring_allreduce())
         except Exception as e:
             errors["allreduce"] = repr(e)[:300]
+    # serving rows (continuous batcher vs static, Llama GQA+int8-kv decode,
+    # speculative): run on every backend — CPU fallback sizes itself down
+    # and the provenance label carries the no-signal caveat
+    if not _skip_for_budget(extras, "serving", 240):
+        try:
+            extras.update(bench_serving())
+        except Exception as e:
+            errors["serving"] = repr(e)[:300]
     if len(jax.devices()) == 1 and not _skip_for_budget(extras, "allreduce_virtual8", 120):
         # multi-chip hosts already measured a ring that hops on real ICI
         extras.update(bench_ring_virtual8())

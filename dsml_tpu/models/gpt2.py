@@ -116,6 +116,36 @@ class GPT2Config:
         )
 
 
+def sample_token_logits(logits, key, temperature: float, top_k: int = 0,
+                        top_p: float = 0.0):
+    """Sample next-token ids from ``logits`` [..., vocab] — greedy at
+    ``temperature <= 0``, else softmax sampling optionally truncated to the
+    ``top_k`` most likely tokens and/or the nucleus holding ``top_p``
+    probability mass. THE one sampler shared by ``generate``/
+    ``generate_spmd`` and the continuous batcher (host and in-scan paths),
+    so the truncation semantics cannot drift between serving surfaces.
+    Pure in (logits, key): callers own the key discipline."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p > 0.0:
+        # nucleus: keep the smallest prefix (by descending prob) whose mass
+        # reaches top_p; always keep the argmax
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # cutoff logit: last sorted position with cum - p < top_p
+        keep = (cum - probs) < top_p  # mass BEFORE this token < p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
 def _layer_norm(x, scale, bias, eps=1e-5):
     x32 = x.astype(jnp.float32)
     mean = x32.mean(-1, keepdims=True)
@@ -1291,25 +1321,7 @@ class GPT2:
             return cache[key_]
 
         def sample(logits, key):
-            if temperature <= 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            logits = logits.astype(jnp.float32) / temperature
-            if top_k > 0:
-                kth = lax.top_k(logits, top_k)[0][..., -1:]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-            if top_p > 0.0:
-                # nucleus: keep the smallest prefix (by descending prob)
-                # whose mass reaches top_p; always keep the argmax
-                sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-                probs = jax.nn.softmax(sorted_logits, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1)
-                # cutoff logit: last sorted position with cum - p < top_p
-                keep = (cum - probs) < top_p  # mass BEFORE this token < p
-                cutoff = jnp.min(
-                    jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
-                )
-                logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
-            return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+            return sample_token_logits(logits, key, temperature, top_k, top_p)
 
         def sample_rows(logits, key):
             if dp_axis is None:

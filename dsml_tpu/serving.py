@@ -30,6 +30,7 @@ adds.
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from typing import Callable
 
@@ -47,6 +48,11 @@ class Request:
     max_new_tokens: int
     tokens: list = dataclasses.field(default_factory=list)  # emitted so far
     done: bool = False
+    # wall-clock marks for the serving latency metrics (time.monotonic)
+    submitted_at: float = 0.0
+    first_token_at: float | None = None
+    finished_at: float | None = None
+    last_emit_at: float | None = None
 
 
 def _bucket(n: int, buckets: tuple) -> int:
@@ -130,6 +136,8 @@ class ContinuousBatcher:
         n_slots: int = 8,
         eos_id: int | None = None,
         temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 0.0,
         seed: int = 0,
         prompt_buckets: tuple = (32, 64, 128, 256, 512, 1024),
         decode_quantum: int = 1,
@@ -150,6 +158,8 @@ class ContinuousBatcher:
         self.n_slots = n_slots
         self.eos_id = eos_id
         self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
         self.seed = seed
         # sorted + deduped: _bucket picks the FIRST bucket >= len(prompt),
         # so an unsorted tuple would silently admit short prompts into the
@@ -171,6 +181,8 @@ class ContinuousBatcher:
         self._queue: deque[Request] = deque()
         self._live: dict[int, Request] = {}  # queued or in a slot
         self._done: dict[int, Request] = {}  # retired, awaiting collect()
+        self._latency: list = []  # (ttft_s, e2e_s) per retired request
+        self._gaps: list = []  # consumer-visible inter-emission gap samples
         self._next_rid = 0
         # slot state (host-side numpy; device state is the cache)
         self._slot_rid = np.full(n_slots, -1, np.int64)  # -1 = free
@@ -202,8 +214,11 @@ class ContinuousBatcher:
         self.speculative_ngram = int(speculative_ngram)
         max_seq = cfg.max_seq
         temperature = self.temperature
+        top_k, top_p = self.top_k, self.top_p
         tp_axis = "tp" if mesh is not None else None
         from jax import lax
+
+        from dsml_tpu.models.gpt2 import sample_token_logits
 
         def decode_k(p, c, t, pos, base_keys, steps_done):
             """k chained slot-decode steps + sampling in ONE program.
@@ -221,9 +236,7 @@ class ContinuousBatcher:
                 else:
                     def one(row, key, n_done):
                         k2 = jax.random.fold_in(key, n_done + i)
-                        return jax.random.categorical(
-                            k2, row.astype(jnp.float32) / temperature
-                        ).astype(jnp.int32)
+                        return sample_token_logits(row, k2, temperature, top_k, top_p)
 
                     nxt = jax.vmap(one)(logits, base_keys, steps_done)
                 return (c, nxt, jnp.minimum(pos + 1, max_seq - 1)), nxt
@@ -336,7 +349,7 @@ class ContinuousBatcher:
         # temperature range) — duplicating it here would let the two paths'
         # contracts drift apart
         self.model._check_generate_args(
-            len(prompt), max_new_tokens, self.temperature, 0, 0.0
+            len(prompt), max_new_tokens, self.temperature, self.top_k, self.top_p
         )
         if self.speculative_window:
             # a continuing slot verifies a full window at pos < L + max_new;
@@ -353,7 +366,8 @@ class ContinuousBatcher:
             _bucket(len(prompt), self.prompt_buckets)
         rid = self._next_rid
         self._next_rid += 1
-        req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens)
+        req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
+                      submitted_at=time.monotonic())
         self._queue.append(req)
         self._live[rid] = req
         return rid
@@ -385,9 +399,12 @@ class ContinuousBatcher:
     def _sample(self, logits: np.ndarray, req: Request) -> int:
         if self.temperature <= 0.0:
             return int(np.argmax(logits))
+        from dsml_tpu.models.gpt2 import sample_token_logits
+
         key = jax.random.fold_in(self._request_key(req.rid), len(req.tokens))
-        scaled = jnp.asarray(logits, jnp.float32) / self.temperature
-        return int(jax.random.categorical(key, scaled))
+        return int(sample_token_logits(
+            jnp.asarray(logits), key, self.temperature, self.top_k, self.top_p
+        ))
 
     def _chunk_grid_fits(self, prompt_len: int) -> bool:
         """True when the chunked path serves this prompt: chunking is on
@@ -421,6 +438,7 @@ class ContinuousBatcher:
         self._cache = self._insert(self._cache, cache1, slot)
         tok = self._sample(np.asarray(logits[0]), req)
         req.tokens.append(tok)
+        req.first_token_at = time.monotonic()
         emitted[req.rid] = [tok]
         if self._finished(req, tok):
             self._retire(req)
@@ -461,6 +479,7 @@ class ContinuousBatcher:
         self._cache = self._insert(self._cache, cache1, slot)
         tok = self._sample(np.asarray(logits[0]), req)
         req.tokens.append(tok)
+        req.first_token_at = time.monotonic()
         emitted[req.rid] = [tok]
         if self._finished(req, tok):
             self._retire(req)
@@ -502,9 +521,60 @@ class ContinuousBatcher:
 
     def _retire(self, req: Request) -> None:
         req.done = True
+        req.finished_at = time.monotonic()
+        self._latency.append((
+            (req.first_token_at or req.finished_at) - req.submitted_at,  # TTFT
+            req.finished_at - req.submitted_at,  # e2e
+        ))
         # move out of the live table so a long-running server doesn't
         # accumulate one Request per lifetime request; collect() drains
         self._done[req.rid] = self._live.pop(req.rid)
+
+    def _note_emissions(self, emitted: dict) -> None:
+        """Record per-request inter-emission GAPS — the consumer-visible
+        latency samples. A quantum/window of k tokens arrives as ONE
+        emission, so a gap spans one scheduler tick; a tick stalled behind
+        another request's admission shows up as a genuinely long gap (the
+        head-of-line signal per-request averages would smooth away)."""
+        now = time.monotonic()
+        for rid, toks in emitted.items():
+            if not toks:
+                continue
+            req = self._live.get(rid) or self._done.get(rid)
+            if req is None:
+                continue
+            if req.last_emit_at is not None:
+                self._gaps.append(now - req.last_emit_at)
+            req.last_emit_at = now
+
+    def latency_stats(self) -> dict:
+        """p50/p99 TTFT, inter-emission gap, and end-to-end seconds since
+        construction (or the last ``reset_latency_stats``) — the standard
+        online-serving metrics; throughput alone hides queueing and
+        head-of-line behavior. ``gap_*`` percentiles are over PER-EMISSION
+        gap samples pooled across requests (with ``decode_quantum=k`` one
+        emission carries up to k tokens — divide by the quantum for a
+        per-token figure)."""
+        out = {"n_requests": len(self._latency)}
+        if not self._latency:
+            return out
+
+        def pct(vals, q):
+            return round(float(np.percentile(np.asarray(vals), q)), 6)
+
+        ttft, e2e = zip(*self._latency)
+        out.update(
+            ttft_p50_s=pct(ttft, 50), ttft_p99_s=pct(ttft, 99),
+            e2e_p50_s=pct(e2e, 50), e2e_p99_s=pct(e2e, 99),
+        )
+        if self._gaps:
+            out["gap_p50_s"] = pct(self._gaps, 50)
+            out["gap_p99_s"] = pct(self._gaps, 99)
+        return out
+
+    def reset_latency_stats(self) -> None:
+        self._latency.clear()
+        self._gaps.clear()
 
     def step(self) -> dict[int, list]:
         """One scheduler tick: admit, one decode QUANTUM over ALL slots,
@@ -512,6 +582,11 @@ class ContinuousBatcher:
         tokens this tick — including each admission's prefill-sampled first
         token (a request finishing mid-quantum gets its truncated tail; the
         over-decoded lane-ticks are the quantum's scheduling cost)."""
+        emitted = self._step_inner()
+        self._note_emissions(emitted)
+        return emitted
+
+    def _step_inner(self) -> dict[int, list]:
         emitted = self._admit_chunked() if self.prefill_chunk else self._admit()
         active = np.flatnonzero(self._slot_rid >= 0)
         if len(active) == 0:

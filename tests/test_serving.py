@@ -111,6 +111,26 @@ def test_temperature_sampling_is_slot_independent():
     assert serve(1) == serve(2)
 
 
+def test_top_k_top_p_sampling_is_schedule_independent():
+    """top_k/top_p truncation rides the shared sample_token_logits (the
+    same function generate uses), and stays slot/quantum-independent:
+    tokens depend only on (seed, rid, step)."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(21)
+    prompts = _prompts(cfg, [6, 11, 8], seed=21)
+
+    def serve(n_slots, quantum):
+        srv = ContinuousBatcher(model, params, n_slots=n_slots, temperature=0.9,
+                                top_k=12, top_p=0.8, seed=5,
+                                prompt_buckets=(16,), decode_quantum=quantum)
+        rids = [srv.submit(p, 5) for p in prompts]
+        out = srv.run()
+        return [out[r] for r in rids]
+
+    assert serve(1, 1) == serve(2, 1) == serve(2, 4)
+
+
 def test_step_streams_every_token_including_prefill_first():
     """A consumer accumulating step() returns sees EVERY token of every
     request — including each admission's prefill-sampled first token and
@@ -459,6 +479,31 @@ def test_speculative_batcher_matches_plain_and_generate():
     rid = srv.submit(prompts[0], 6)
     out = srv.run()
     assert out[rid] == ref[: ref.index(eos) + 1]
+
+
+def test_latency_stats_track_requests():
+    """TTFT/ITL/e2e percentiles accumulate per retired request, warmups
+    can be reset out, and the invariants hold (ttft <= e2e; itl present
+    only for multi-token requests)."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(19)
+    srv = ContinuousBatcher(model, params, n_slots=2, prompt_buckets=(16,))
+    assert srv.latency_stats() == {"n_requests": 0}
+    srv.submit(_prompts(cfg, [5], seed=19)[0], 3)
+    srv.run()
+    srv.reset_latency_stats()
+    assert srv.latency_stats() == {"n_requests": 0}
+
+    for p, n in zip(_prompts(cfg, [5, 9, 7], seed=20), (4, 1, 6)):
+        srv.submit(p, n)
+    srv.run()
+    stats = srv.latency_stats()
+    assert stats["n_requests"] == 3
+    assert 0 < stats["ttft_p50_s"] <= stats["e2e_p50_s"]
+    assert stats["ttft_p99_s"] <= stats["e2e_p99_s"]
+    # two of three requests decoded past their first emission → gap samples
+    assert stats["gap_p50_s"] > 0 and stats["gap_p99_s"] >= stats["gap_p50_s"]
 
 
 def test_speculative_batcher_validation():
