@@ -174,16 +174,34 @@ def bench_gpt2_decode() -> dict:
 
     n_short, n_long = 16, 144
 
-    def timed(n_new):  # D2H (np.asarray) forces the sync
-        return _p50_wall(lambda: np.asarray(model.generate(params, prompt, n_new)))
+    def timed(ps, n_new):  # D2H (np.asarray) forces the sync
+        return _p50_wall(lambda: np.asarray(model.generate(ps, prompt, n_new)))
 
-    per_step = (timed(n_long) - timed(n_short)) / (n_long - n_short)
-    return {
+    per_step = (timed(params, n_long) - timed(params, n_short)) / (n_long - n_short)
+    out = {
         "gpt2_decode_tokens_per_sec": round(batch / per_step, 1),
         "gpt2_decode_step_ms": round(per_step * 1e3, 3),
         "gpt2_decode_batch": batch,
         "gpt2_decode_prompt_len": prompt_len,
     }
+    # weight-only int8 variant: decode is weight-HBM-bound, so halved
+    # weight bytes should show directly in tokens/s (same differenced
+    # methodology — the rows are directly comparable)
+    try:
+        from dsml_tpu.models.common import quantize_weights_int8
+
+        # jnp ops follow their input's device: quantizing the device-
+        # resident params directly avoids a full D2H+H2D round trip
+        qp = quantize_weights_int8(params)
+        per_q = (timed(qp, n_long) - timed(qp, n_short)) / (n_long - n_short)
+        out.update({
+            "gpt2_decode_wq8_tokens_per_sec": round(batch / per_q, 1),
+            "gpt2_decode_wq8_step_ms": round(per_q * 1e3, 3),
+            "gpt2_decode_wq8_speedup": round(per_step / per_q, 2),
+        })
+    except Exception as e:
+        out["gpt2_decode_wq8_error"] = repr(e)[:200]
+    return out
 
 
 def _gpt2_train_throughput(

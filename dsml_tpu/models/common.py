@@ -1,5 +1,6 @@
-"""Shared model utilities: initializers, classification losses, and the
-FSDP spec transform every family's ``param_specs`` routes through."""
+"""Shared model utilities: initializers, classification losses, the
+FSDP spec transform every family's ``param_specs`` routes through, and
+weight-only int8 quantization for the serving path."""
 
 from __future__ import annotations
 
@@ -7,7 +8,68 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["he_init", "softmax_xent", "count_correct", "with_fsdp", "fsdp_spec_fn"]
+__all__ = [
+    "he_init", "softmax_xent", "count_correct", "with_fsdp", "fsdp_spec_fn",
+    "quantize_weights_int8", "maybe_dequant",
+]
+
+# transformer-block matmul weights both families contract on AXIS 0 —
+# the per-output-channel absmax scale is therefore max|w| over axis 0
+# (GPT-2: fused wqkv [d, 3, d] keeps a scale per (qkv-slot, channel))
+_WQ_KEYS = frozenset({
+    "wqkv", "wo", "wq", "wk", "wv",           # attention projections
+    "w_in", "w_out", "w_gate", "w_up", "w_down",  # dense MLP
+})
+
+
+def quantize_weights_int8(params: dict) -> dict:
+    """Weight-only int8 (w8a16) for SERVING: every transformer-block
+    attention/MLP matmul weight becomes ``{"qw": int8, "qs": f32 scale}``
+    with per-output-channel absmax scales; embeddings, the unembedding,
+    norms, biases, and MoE experts stay full precision (MoE contracts on
+    a middle axis and the gate is routing-sensitive — out of scope).
+
+    Decode is weight-HBM-bandwidth-bound, so halving weight bytes vs bf16
+    (4x vs f32) raises decode tokens/s; the int8→float convert + scale
+    feed the dot operand, which XLA fuses into the matmul read — no
+    dequantized weight copy is ever materialized in HBM. Quantized params
+    serve the single-device decode surfaces (``generate``, the continuous
+    batcher, speculative decode); the TP/shard_map paths expect plain
+    leaves matching ``param_specs`` and are not supported."""
+
+    def quant_layer(layer: dict) -> dict:
+        out = {}
+        for group, leaves in layer.items():
+            if group in ("attn", "mlp") and isinstance(leaves, dict):
+                out[group] = {
+                    k: _quant_leaf(v) if k in _WQ_KEYS else v
+                    for k, v in leaves.items()
+                }
+            else:
+                out[group] = leaves
+        return out
+
+    def _quant_leaf(w: jax.Array) -> dict:
+        a = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0, keepdims=True)
+        qs = jnp.where(a > 0, a / 127.0, 1.0)
+        qw = jnp.round(w.astype(jnp.float32) / qs).astype(jnp.int8)
+        return {"qw": qw, "qs": qs.astype(jnp.float32)}
+
+    return {
+        k: ([quant_layer(l) for l in v] if k == "layers" else v)
+        for k, v in params.items()
+    }
+
+
+def maybe_dequant(w, dtype=None):
+    """Matmul-site hook for weight-only int8: plain arrays pass through;
+    ``{"qw", "qs"}`` leaves dequantize into the requested dtype (default
+    f32) right at the dot operand, where XLA fuses the convert+scale into
+    the read instead of materializing a full-width copy."""
+    if isinstance(w, dict) and "qw" in w:
+        dt = dtype or jnp.float32
+        return w["qw"].astype(dt) * w["qs"].astype(dt)
+    return w
 
 
 def with_fsdp(spec, shape: tuple, fsdp: int, axis: str = "fsdp"):

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dsml_tpu.models.common import maybe_dequant
 from dsml_tpu.models.gpt2 import GPT2
 from dsml_tpu.ops.attention import _NEG_INF
 
@@ -279,9 +280,9 @@ class Llama(GPT2):
             b, s, _ = t.shape
             return t.reshape(b, s, n, hd).transpose(0, 2, 1, 3)
 
-        q = heads(x @ layer["attn"]["wq"], n_head_local)
-        k = heads(x @ layer["attn"]["wk"], n_kv_local)
-        v = heads(x @ layer["attn"]["wv"], n_kv_local)
+        q = heads(x @ maybe_dequant(layer["attn"]["wq"], x.dtype), n_head_local)
+        k = heads(x @ maybe_dequant(layer["attn"]["wk"], x.dtype), n_kv_local)
+        v = heads(x @ maybe_dequant(layer["attn"]["wv"], x.dtype), n_kv_local)
         q = _rope(q, positions, self.config.rope_theta)
         k = _rope(k, positions, self.config.rope_theta)
         repeat = n_head_local // n_kv_local
@@ -300,7 +301,7 @@ class Llama(GPT2):
         x = _rms_norm(h, layer["rms_1"]["scale"], cfg.rms_eps)
         q, _, _, ka, va = self._qkv_gqa(layer, x, n_head_local, n_kv_local, positions)
         out = self._route_attention(q, ka, va, sp_axis, attn_impl)
-        out = self._merge_heads(out) @ layer["attn"]["wo"]
+        out = self._merge_heads(out) @ maybe_dequant(layer["attn"]["wo"], out.dtype)
         if tp_axis:
             out = lax.psum(out, tp_axis)
         h = h + out
@@ -308,8 +309,8 @@ class Llama(GPT2):
         return h
 
     def _mlp_block(self, mlp, x, tp_axis):
-        mid = jax.nn.silu(x @ mlp["w_gate"]) * (x @ mlp["w_up"])  # [b, s, ff/tp]
-        out = mid @ mlp["w_down"]
+        mid = jax.nn.silu(x @ maybe_dequant(mlp["w_gate"], x.dtype)) * (x @ maybe_dequant(mlp["w_up"], x.dtype))  # [b, s, ff/tp]
+        out = mid @ maybe_dequant(mlp["w_down"], x.dtype)
         if tp_axis:
             out = lax.psum(out, tp_axis)  # Megatron psum #2
         return out

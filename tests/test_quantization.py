@@ -18,6 +18,59 @@ from dsml_tpu.ops.quantization import (
 )
 
 
+def test_weight_only_int8_serving_close_and_scheduling_exact():
+    """Weight-only int8 (w8a16): quantized params serve every single-device
+    decode surface with logits close to full precision, and the batcher's
+    scheduling-independence stays EXACT under quantization (the quantized
+    model is just another model)."""
+    from dsml_tpu.models.common import quantize_weights_int8
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.models.llama import Llama, LlamaConfig
+    from dsml_tpu.serving import ContinuousBatcher
+
+    for model in (GPT2(GPT2Config.tiny()), Llama(LlamaConfig.tiny())):
+        name = type(model).__name__
+        params = model.init(23)
+        qp = quantize_weights_int8(params)
+        rng = np.random.default_rng(23)
+        prompt = jnp.asarray(rng.integers(0, 512, (2, 12)), jnp.int32)
+        lf, _ = model.prefill(params, prompt, last_index=11)
+        lq, _ = model.prefill(qp, prompt, last_index=11)
+        # per-channel absmax int8 on ~N(0, 0.02) weights: tiny logit drift
+        np.testing.assert_allclose(np.asarray(lq), np.asarray(lf), atol=0.05,
+                                   rtol=0, err_msg=name)
+
+        # the batcher (incl. speculative) serves the quantized params and
+        # matches the quantized generate token-for-token
+        ref = np.asarray(model.generate(qp, prompt[:1], 6))[0].tolist()
+        for kw in ({}, {"speculative_window": 4}):
+            srv = ContinuousBatcher(model, qp, n_slots=2, prompt_buckets=(16,), **kw)
+            rid = srv.submit(np.asarray(prompt[0]), 6)
+            out = srv.run()
+            assert out[rid] == ref, (name, kw)
+
+
+def test_weight_only_int8_shrinks_block_weights():
+    """The quantized pytree's block matmul weights are int8 (≈4x below
+    f32 + a thin scale row); embeddings/norms/biases stay full width."""
+    from dsml_tpu.models.common import quantize_weights_int8
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+
+    model = GPT2(GPT2Config.tiny())
+    params = model.init(0)
+    qp = quantize_weights_int8(params)
+
+    def nbytes(t):
+        return sum(v.size * v.dtype.itemsize for v in jax.tree.leaves(t))
+
+    for group in ("attn", "mlp"):
+        full = nbytes(params["layers"][0][group])
+        quant = nbytes(qp["layers"][0][group])
+        assert quant < full / 2.5, (group, quant, full)
+    assert qp["layers"][0]["attn"]["wqkv"]["qw"].dtype == jnp.int8
+    assert qp["wte"].dtype == params["wte"].dtype  # embeddings untouched
+
+
 def test_quantize_roundtrip_error_bounded():
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((1000,)) * 3.0, jnp.float32)
