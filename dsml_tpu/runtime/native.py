@@ -32,21 +32,44 @@ def _load():
     with _lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO):
+        def build() -> bool:
             try:
                 subprocess.run(
-                    ["make", "-C", _DIR], check=True, capture_output=True, timeout=120
+                    ["make", "-B", "-C", _DIR], check=True, capture_output=True,
+                    timeout=120,
                 )
+                return True
             except (subprocess.SubprocessError, FileNotFoundError) as e:
                 log.warning("native runtime build failed (%s); using Python fallbacks", e)
-                _lib = False
                 return False
+
+        if not os.path.exists(_SO) and not build():
+            _lib = False
+            return False
         try:
             lib = ctypes.CDLL(_SO)
         except OSError as e:
             log.warning("native runtime load failed (%s); using Python fallbacks", e)
             _lib = False
             return False
+        if not hasattr(lib, "ds_prefetch_new"):
+            # a stale .so from an older source revision (the library is
+            # built, not tracked): force-rebuild once and reload rather
+            # than crashing every feature on the missing symbol
+            log.info("native runtime .so is stale; rebuilding")
+            if not build():
+                _lib = False
+                return False
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError as e:
+                log.warning("native runtime reload failed (%s); using Python fallbacks", e)
+                _lib = False
+                return False
+            if not hasattr(lib, "ds_prefetch_new"):
+                log.warning("native runtime still missing symbols; using Python fallbacks")
+                _lib = False
+                return False
         lib.ds_arena_new.restype = ctypes.c_void_p
         lib.ds_arena_new.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
         lib.ds_arena_free.argtypes = [ctypes.c_void_p]
@@ -70,6 +93,14 @@ def _load():
         lib.ds_reduce_f32.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p]
         lib.ds_idx_parse.restype = ctypes.c_int64
         lib.ds_idx_parse.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p]
+        lib.ds_prefetch_new.restype = ctypes.c_void_p
+        lib.ds_prefetch_new.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.ds_prefetch_next.restype = ctypes.c_int64
+        lib.ds_prefetch_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.ds_prefetch_free.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
 
@@ -172,6 +203,68 @@ def reduce_f32(rows: np.ndarray, op: int) -> np.ndarray:
     combine = {0: np.add.reduce, 1: np.multiply.reduce, 2: np.minimum.reduce,
                3: np.maximum.reduce, 4: lambda a: np.add.reduce(a) / a.shape[0]}[int(op)]
     return combine(rows).astype(np.float32)
+
+
+class NativePrefetcher:
+    """Background-thread batch pipeline over the C++ loader: a producer
+    thread gathers each batch's rows from ``dataset`` into a ring of
+    ``depth`` slots while the consumer is inside its device step, so the
+    host-side gather/copy overlaps device compute (the double-buffering a
+    real input pipeline provides — the reference's loader is a synchronous
+    loop, ``client.go:579-653``).
+
+    Iterate to receive ``[batch, *row_shape]`` arrays in index order:
+
+        for xb in NativePrefetcher(train_x, perm_indices):
+            step(params, jnp.asarray(xb))
+
+    ``indices`` is [n_batches, batch] int32 row ids (an epoch's
+    permutation reshaped). The dataset and index arrays are BORROWED by
+    the C++ thread — the prefetcher keeps references so they outlive it.
+    """
+
+    def __init__(self, dataset: np.ndarray, indices: np.ndarray, depth: int = 2):
+        lib = _load()
+        if not lib:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        # keep the borrowed buffers alive for the producer thread
+        self._data = np.ascontiguousarray(dataset)
+        self._idx = np.ascontiguousarray(indices, np.int32)
+        if self._idx.ndim != 2:
+            raise ValueError(f"indices must be [n_batches, batch], got {self._idx.shape}")
+        self._row_shape = self._data.shape[1:]
+        self._row_bytes = int(np.prod(self._row_shape, dtype=np.int64)) * self._data.dtype.itemsize
+        if not 1 <= int(depth) <= 1024:
+            raise ValueError(f"depth must be in [1, 1024], got {depth}")
+        self.n_batches, self.batch = map(int, self._idx.shape)
+        self._ptr = lib.ds_prefetch_new(
+            self._data.ctypes.data_as(ctypes.c_void_p), self._data.shape[0],
+            self._row_bytes,
+            self._idx.ctypes.data_as(ctypes.c_void_p), self.n_batches,
+            self.batch, int(depth),
+        )
+        if not self._ptr:
+            raise ValueError("bad prefetcher arguments (zero batch/depth/row)")
+
+    def __iter__(self):
+        while True:
+            # a fresh array per batch: ds_prefetch_next's memcpy is the ONE
+            # consumer-side copy, and the caller owns the result outright
+            out = np.empty((self.batch, *self._row_shape), self._data.dtype)
+            rc = self._lib.ds_prefetch_next(
+                self._ptr, out.ctypes.data_as(ctypes.c_void_p)
+            )
+            if rc == -1:
+                return
+            if rc < 0:
+                raise IndexError("prefetcher row index out of range")
+            yield out
+
+    def __del__(self):
+        if getattr(self, "_ptr", None):
+            self._lib.ds_prefetch_free(self._ptr)
+            self._ptr = None
 
 
 def idx_parse(blob: bytes) -> tuple[np.ndarray, tuple[int, ...]]:

@@ -16,14 +16,20 @@
 //   * reduce       — dtype-aware elementwise reductions (SUM/PROD/MIN/MAX/AVG)
 //                    for the coordinator's cross-host fallback path.
 //   * idx parser   — IDX (MNIST) header/payload decoding.
+//   * prefetch     — background-thread batch gather into a slot ring (the
+//                    double-buffered input pipeline; host copy overlaps the
+//                    device step).
 //
 // C ABI throughout; Python binds via ctypes (dsml_tpu/runtime/native.py).
 // Build: make -C dsml_tpu/runtime/native   ->  libdsml_runtime.so
 
+#include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <map>
 #include <mutex>
+#include <thread>
 #include <vector>
 
 extern "C" {
@@ -259,6 +265,119 @@ int64_t ds_idx_parse(const uint8_t* buf, uint64_t len, int32_t* dims_out) {
     return 8;
   }
   return -DS_INVALID;
+}
+
+// ---------------------------------------------------------------------------
+// prefetching batch loader
+// ---------------------------------------------------------------------------
+// Background producer thread gathers batch rows from a borrowed dataset
+// blob into a ring of `depth` slots while the consumer (the training loop)
+// is inside its device step — the host-side gather/copy overlaps device
+// compute instead of serializing with it (the double-buffered input
+// pipeline a real data loader provides; the reference's loader is a
+// synchronous Go loop, client.go:270-350 + :579-653).
+
+struct DsPrefetch {
+  const uint8_t* data;    // borrowed — caller keeps the dataset alive
+  uint64_t n_rows = 0, row_bytes = 0;
+  const int32_t* idx;     // borrowed [n_batches * batch] row indices
+  uint64_t n_batches = 0, batch = 0, depth = 0;
+  std::vector<std::vector<uint8_t>> slots;
+  uint64_t head = 0;      // next batch the producer fills
+  uint64_t tail = 0;      // next batch the consumer takes
+  std::atomic<bool> stop{false};
+  int32_t error = DS_OK;
+  std::mutex mu;
+  std::condition_variable cv_prod, cv_cons;
+  std::thread worker;
+};
+
+static void ds_prefetch_run(DsPrefetch* p) {
+  for (;;) {
+    std::unique_lock<std::mutex> lock(p->mu);
+    p->cv_prod.wait(lock, [p] {
+      return p->stop.load() || p->head - p->tail < p->depth;
+    });
+    if (p->stop.load() || p->head >= p->n_batches || p->error != DS_OK) return;
+    uint64_t b = p->head;
+    lock.unlock();  // gather outside the lock: the consumer may drain slots
+
+    std::vector<uint8_t>& slot = p->slots[b % p->depth];
+    int32_t err = DS_OK;
+    for (uint64_t j = 0; j < p->batch; ++j) {
+      int64_t row = p->idx[b * p->batch + j];
+      if (row < 0 || uint64_t(row) >= p->n_rows) {
+        err = DS_OUT_OF_RANGE;
+        break;
+      }
+      std::memcpy(slot.data() + j * p->row_bytes,
+                  p->data + uint64_t(row) * p->row_bytes, p->row_bytes);
+    }
+
+    lock.lock();
+    if (err != DS_OK) {
+      p->error = err;
+      p->cv_cons.notify_all();
+      return;
+    }
+    p->head = b + 1;
+    bool done = p->head >= p->n_batches;
+    p->cv_cons.notify_all();
+    if (done) return;
+  }
+}
+
+void* ds_prefetch_new(const uint8_t* data, uint64_t n_rows, uint64_t row_bytes,
+                      const int32_t* idx, uint64_t n_batches, uint64_t batch,
+                      uint64_t depth) {
+  // depth is a small ring (2-4 in practice); a huge value — e.g. Python's
+  // -1 wrapped through uint64 — would make the slot allocation throw
+  // bad_alloc straight through the C ABI and abort the process
+  if (depth == 0 || depth > 1024 || batch == 0 || row_bytes == 0) return nullptr;
+  auto* p = new DsPrefetch();
+  p->data = data;
+  p->n_rows = n_rows;
+  p->row_bytes = row_bytes;
+  p->idx = idx;
+  p->n_batches = n_batches;
+  p->batch = batch;
+  p->depth = depth;
+  p->slots.assign(depth, std::vector<uint8_t>(batch * row_bytes));
+  p->worker = std::thread(ds_prefetch_run, p);
+  return p;
+}
+
+// Blocks until the next batch is ready and copies it into `out`
+// ([batch * row_bytes] bytes). Returns the batch index, -1 once all
+// batches were delivered, or -2 on a producer error (bad row index).
+int64_t ds_prefetch_next(void* handle, uint8_t* out) {
+  auto* p = static_cast<DsPrefetch*>(handle);
+  std::unique_lock<std::mutex> lock(p->mu);
+  p->cv_cons.wait(lock, [p] {
+    return p->tail < p->head || p->error != DS_OK || p->tail >= p->n_batches;
+  });
+  // drain already-produced batches BEFORE surfacing a producer error, so
+  // delivery up to the bad batch is deterministic regardless of how far
+  // ahead the producer ran
+  if (p->tail >= p->n_batches) return -1;
+  if (p->tail >= p->head && p->error != DS_OK) return -2;
+  uint64_t b = p->tail;
+  std::memcpy(out, p->slots[b % p->depth].data(), p->batch * p->row_bytes);
+  p->tail = b + 1;
+  p->cv_prod.notify_one();
+  return static_cast<int64_t>(b);
+}
+
+void ds_prefetch_free(void* handle) {
+  auto* p = static_cast<DsPrefetch*>(handle);
+  {
+    std::lock_guard<std::mutex> lock(p->mu);
+    p->stop.store(true);
+  }
+  p->cv_prod.notify_all();
+  p->cv_cons.notify_all();
+  if (p->worker.joinable()) p->worker.join();
+  delete p;
 }
 
 }  // extern "C"
