@@ -32,20 +32,22 @@ def _load():
     with _lock:
         if _lib is not None:
             return _lib
-        def build() -> bool:
-            try:
-                subprocess.run(
-                    ["make", "-B", "-C", _DIR], check=True, capture_output=True,
-                    timeout=120,
-                )
-                return True
-            except (subprocess.SubprocessError, FileNotFoundError) as e:
+        # incremental make BEFORE the first dlopen: a no-op when the .so is
+        # fresh, a relink when the source is newer. Rebuild-then-reload
+        # inside one process cannot work (ctypes caches the mapping by
+        # path and never dlcloses), so a stale library must never be
+        # loaded in the first place.
+        built = True
+        try:
+            subprocess.run(
+                ["make", "-C", _DIR], check=True, capture_output=True, timeout=120
+            )
+        except (subprocess.SubprocessError, FileNotFoundError) as e:
+            built = False
+            if not os.path.exists(_SO):
                 log.warning("native runtime build failed (%s); using Python fallbacks", e)
+                _lib = False
                 return False
-
-        if not os.path.exists(_SO) and not build():
-            _lib = False
-            return False
         try:
             lib = ctypes.CDLL(_SO)
         except OSError as e:
@@ -53,23 +55,15 @@ def _load():
             _lib = False
             return False
         if not hasattr(lib, "ds_prefetch_new"):
-            # a stale .so from an older source revision (the library is
-            # built, not tracked): force-rebuild once and reload rather
-            # than crashing every feature on the missing symbol
-            log.info("native runtime .so is stale; rebuilding")
-            if not build():
-                _lib = False
-                return False
-            try:
-                lib = ctypes.CDLL(_SO)
-            except OSError as e:
-                log.warning("native runtime reload failed (%s); using Python fallbacks", e)
-                _lib = False
-                return False
-            if not hasattr(lib, "ds_prefetch_new"):
-                log.warning("native runtime still missing symbols; using Python fallbacks")
-                _lib = False
-                return False
+            # only reachable when make was unavailable and an old .so was
+            # the best we had — degrade for this process; the next process
+            # with a toolchain rebuilds
+            log.warning(
+                "native runtime .so is stale%s; using Python fallbacks",
+                "" if built else " and no compiler is available",
+            )
+            _lib = False
+            return False
         lib.ds_arena_new.restype = ctypes.c_void_p
         lib.ds_arena_new.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
         lib.ds_arena_free.argtypes = [ctypes.c_void_p]
@@ -238,6 +232,7 @@ class NativePrefetcher:
         if not 1 <= int(depth) <= 1024:
             raise ValueError(f"depth must be in [1, 1024], got {depth}")
         self.n_batches, self.batch = map(int, self._idx.shape)
+        self._consumed = False
         self._ptr = lib.ds_prefetch_new(
             self._data.ctypes.data_as(ctypes.c_void_p), self._data.shape[0],
             self._row_bytes,
@@ -248,6 +243,14 @@ class NativePrefetcher:
             raise ValueError("bad prefetcher arguments (zero batch/depth/row)")
 
     def __iter__(self):
+        # the C++ ring drains once; a second epoch silently yielding zero
+        # batches would halve a training run with no signal — be loud
+        if self._consumed:
+            raise RuntimeError(
+                "NativePrefetcher is single-use: construct a new one per "
+                "epoch (each carries its own permutation indices anyway)"
+            )
+        self._consumed = True
         while True:
             # a fresh array per batch: ds_prefetch_next's memcpy is the ONE
             # consumer-side copy, and the caller owns the result outright

@@ -114,6 +114,16 @@ class ContinuousBatcher:
     within-prompt attention, the standard chunked-prefill approximation).
     0 (default) keeps whole-prompt bucketed admission.
 
+    ``register_prefix(tokens)`` — PREFIX CACHING for shared prompt heads
+    (the system-prompt pattern): the prefix's cache rows and next-token
+    logits are computed once; any later prompt starting with a registered
+    prefix admits by COPYING those rows and chunk-prefilling only the
+    suffix, cutting admission prefill from O(L) to O(L - P) (the TTFT
+    win). Requires ``prefill_chunk > 0`` (the suffix rides the chunk
+    path); the longest matching prefix is used; tokens are identical with
+    or without the cache (prefix rows attend only within the prefix under
+    causality, so they equal the full prefill's — pinned in tests).
+
     ``speculative_window`` — when >= 2, each decode tick runs PROMPT-LOOKUP
     SPECULATIVE decoding across all slots: every active slot drafts
     window−1 tokens from the most recent n-gram match in its own history
@@ -183,6 +193,7 @@ class ContinuousBatcher:
         self._done: dict[int, Request] = {}  # retired, awaiting collect()
         self._latency: list = []  # (ttft_s, e2e_s) per retired request
         self._gaps: list = []  # consumer-visible inter-emission gap samples
+        self._prefixes: list = []  # (tokens, cache1, last_logits) len-desc
         self._next_rid = 0
         # slot state (host-side numpy; device state is the cache)
         self._slot_rid = np.full(n_slots, -1, np.int64)  # -1 = free
@@ -372,6 +383,53 @@ class ContinuousBatcher:
         self._live[rid] = req
         return rid
 
+    def register_prefix(self, tokens) -> None:
+        """Precompute and retain the KV rows + next-token logits for a
+        shared prompt head (a system prompt). Later ``submit``s whose
+        prompt starts with the longest registered prefix admit by copying
+        these rows and chunk-prefilling only the suffix. Registration is
+        a blocking setup call (it runs the prefix's chunked prefill)."""
+        if not self.prefill_chunk:
+            raise ValueError("prefix caching requires prefill_chunk > 0")
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = len(tokens)
+        if n < 1:
+            raise ValueError("empty prefix")
+        if not self._chunk_grid_fits(n):
+            raise ValueError(
+                f"prefix length {n} exceeds the chunk grid for max_seq="
+                f"{self.model.config.max_seq}"
+            )
+        c = self.prefill_chunk
+        cache1 = self._fresh_cache1()
+        logits = None
+        for start in range(0, n, c):
+            end = min(start + c, n)
+            padded = np.zeros((1, c), np.int32)
+            padded[0, : end - start] = tokens[start:end]
+            last_local = (n - 1) - start if end >= n else c - 1
+            logits, cache1 = self._prefill_chunk(
+                self.params, cache1, jnp.asarray(padded),
+                jnp.int32(start), jnp.int32(last_local),
+            )
+        self._prefixes.append((tokens, cache1, np.asarray(logits[0])))
+        self._prefixes.sort(key=lambda p: -len(p[0]))  # longest match wins
+
+    def _match_prefix(self, prompt: np.ndarray):
+        """Longest registered prefix that heads ``prompt`` AND whose
+        suffix chunk grid stays inside the cache; None otherwise."""
+        L = len(prompt)
+        c = self.prefill_chunk
+        max_seq = self.model.config.max_seq
+        for ptoks, pcache, plogits in self._prefixes:
+            p = len(ptoks)
+            if p > L or not np.array_equal(prompt[:p], ptoks):
+                continue
+            if p < L and p + (-(-(L - p) // c)) * c > max_seq:
+                continue  # padded suffix grid would overrun the cache
+            return ptoks, pcache, plogits
+        return None
+
     @property
     def n_active(self) -> int:
         return int((self._slot_rid >= 0).sum())
@@ -424,6 +482,21 @@ class ContinuousBatcher:
         self._last_tok[slot] = tok
         self._slot_key[slot] = np.asarray(self._request_key(req.rid))
 
+    def _finish_admission(self, req: Request, slot: int, logits_row, emitted: dict) -> None:
+        """THE admission epilogue — shared by whole-prompt, chunked, and
+        exact-prefix admissions so the bookkeeping cannot drift: sample the
+        first token, stamp TTFT, emit, then retire (slot stays free) or
+        occupy."""
+        tok = self._sample(np.asarray(logits_row), req)
+        req.tokens.append(tok)
+        req.first_token_at = time.monotonic()
+        emitted[req.rid] = [tok]
+        if self._finished(req, tok):
+            self._retire(req)
+            self._slot_rid[slot] = -1  # release any reservation
+            return
+        self._occupy(req, slot, tok)
+
     def _admit_full(self, req: Request, slot: int, emitted: dict) -> None:
         """Whole-prompt bucketed prefill + cache insert + first sampled
         token. A request that finishes AT prefill (budget 1 or immediate
@@ -436,14 +509,7 @@ class ContinuousBatcher:
             self.params, jnp.asarray(padded), jnp.int32(L - 1)
         )
         self._cache = self._insert(self._cache, cache1, slot)
-        tok = self._sample(np.asarray(logits[0]), req)
-        req.tokens.append(tok)
-        req.first_token_at = time.monotonic()
-        emitted[req.rid] = [tok]
-        if self._finished(req, tok):
-            self._retire(req)
-            return
-        self._occupy(req, slot, tok)
+        self._finish_admission(req, slot, logits[0], emitted)
 
     def _admit(self) -> dict[int, list]:
         """Fill free slots from the queue (whole-prompt admission path).
@@ -477,15 +543,7 @@ class ContinuousBatcher:
             return False
         self._pending = None
         self._cache = self._insert(self._cache, cache1, slot)
-        tok = self._sample(np.asarray(logits[0]), req)
-        req.tokens.append(tok)
-        req.first_token_at = time.monotonic()
-        emitted[req.rid] = [tok]
-        if self._finished(req, tok):
-            self._retire(req)
-            self._slot_rid[slot] = -1  # release the reservation
-            return True
-        self._occupy(req, slot, tok)
+        self._finish_admission(req, slot, logits[0], emitted)
         return True
 
     def _admit_chunked(self) -> dict[int, list]:
@@ -511,6 +569,24 @@ class ContinuousBatcher:
                 self._admit_full(req, int(free[0]), emitted)
                 continue
             slot = int(free[0])
+            pre = self._prefixes and self._match_prefix(req.prompt)
+            if pre:
+                ptoks, pcache, plogits = pre
+                if len(ptoks) == len(req.prompt):
+                    # the whole prompt is the stored prefix: admission
+                    # completes with zero prefill work (_insert does not
+                    # donate its source, so the master rows stay intact)
+                    self._cache = self._insert(self._cache, pcache, slot)
+                    self._finish_admission(req, slot, plogits, emitted)
+                    continue
+                # suffix-only prefill: the pending cache starts as a COPY of
+                # the prefix rows (the chunk program donates its cache arg —
+                # the stored master must survive for the next match)
+                self._slot_rid[slot] = -2
+                self._pending = (
+                    req, slot, jax.tree.map(jnp.copy, pcache), len(ptoks)
+                )
+                continue
             self._slot_rid[slot] = -2  # reserve: not free, not decoding
             self._pending = (req, slot, self._fresh_cache1(), 0)
 

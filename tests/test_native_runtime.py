@@ -116,6 +116,12 @@ def test_prefetcher_rejects_bad_rows_and_shapes():
     with pytest.raises(ValueError, match="depth"):
         # a negative depth would wrap through uint64 and bad_alloc in C++
         native.NativePrefetcher(data, np.zeros((2, 2), np.int32), depth=-1)
+    # single-use: a second epoch over a drained ring must be loud, not a
+    # silent zero-batch loop
+    pf = native.NativePrefetcher(data, np.zeros((2, 2), np.int32))
+    assert len(list(pf)) == 2
+    with pytest.raises(RuntimeError, match="single-use"):
+        list(pf)
 
 
 def test_prefetcher_drains_valid_batches_before_error():

@@ -506,6 +506,67 @@ def test_latency_stats_track_requests():
     assert stats["gap_p50_s"] > 0 and stats["gap_p99_s"] >= stats["gap_p50_s"]
 
 
+def test_prefix_cache_tokens_identical_and_prefill_work_drops():
+    """register_prefix: prompts sharing a registered head admit by copying
+    the stored rows and chunk-prefilling only the suffix — tokens equal
+    the uncached batcher AND standalone generate, while admission chunk
+    calls drop by the shared-prefix work. Covers suffix admissions, an
+    exact-prefix prompt (zero prefill work), an unrelated prompt, and
+    longest-match among two registered prefixes."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(25)
+    rng = np.random.default_rng(25)
+    system = rng.integers(0, cfg.vocab_size, (40,)).astype(np.int32)
+    longer = np.concatenate([system, rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)])
+    prompts = [
+        np.concatenate([system, rng.integers(0, cfg.vocab_size, (l,)).astype(np.int32)])
+        for l in (5, 20)
+    ] + [
+        np.concatenate([longer, rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)]),
+        rng.integers(0, cfg.vocab_size, (12,)).astype(np.int32),  # unrelated
+        system.copy(),  # exactly the prefix
+    ]
+    budgets = [6, 4, 5, 7, 3]
+
+    def serve(register):
+        srv = ContinuousBatcher(model, params, n_slots=2,
+                                prompt_buckets=(64, 128), prefill_chunk=16)
+        calls = [0]
+        orig = srv._prefill_chunk
+
+        def counting(*a, **k):
+            calls[0] += 1
+            return orig(*a, **k)
+
+        srv._prefill_chunk = counting
+        for p in register:
+            srv.register_prefix(p)
+        setup = calls[0]
+        rids = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+        out = srv.run()
+        return [out[r] for r in rids], calls[0] - setup
+
+    plain, n_plain = serve([])
+    cached, n_cached = serve([system, longer])
+    assert cached == plain
+    assert n_cached < n_plain  # the shared-head prefill work disappeared
+    for toks, p, n in zip(cached, prompts, budgets):
+        assert toks == _reference(model, params, p, n)
+
+
+def test_prefix_cache_validation():
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    srv = ContinuousBatcher(model, model.init(0), prompt_buckets=(16,))
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        srv.register_prefix(np.zeros(4, np.int32))
+    srv2 = ContinuousBatcher(model, model.init(0), prompt_buckets=(16,),
+                             prefill_chunk=16)
+    with pytest.raises(ValueError, match="empty"):
+        srv2.register_prefix(np.zeros(0, np.int32))
+
+
 def test_speculative_batcher_validation():
     cfg = GPT2Config.tiny()
     model = GPT2(cfg)
