@@ -35,7 +35,7 @@ SECTIONS = [
     ("mnist", 600),
     ("gpt2_medium", 1200),  # biggest compile (~130 s) last
     ("realtext", 1200),
-    ("serving", 900),
+    ("serving", 1800),  # many programs: chunk/decode/static/spec/llama+verify
 ]
 
 PROBE = (
