@@ -89,6 +89,29 @@ def main() -> None:
         params, opt_state, loss = step(params, opt_state, global_batch(gx), global_batch(gy))
         losses.append(float(np.asarray(jax.device_get(loss))))
 
+    # (c) multi-host SERVING: dp×tp generate_spmd over the 4-device global
+    # mesh — TP psums and the vocab-shard all_gather cross the process
+    # boundary; each host reads back only its addressable dp rows and the
+    # test pins them against the single-device greedy reference
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    gcfg = GPT2Config(
+        vocab_size=128, max_seq=32, n_layer=2, n_head=4, d_model=32, d_ff=64
+    )
+    gpt = GPT2(gcfg)
+    gparams = gpt.init(0)
+    srng = np.random.default_rng(7)  # same seed on both hosts
+    prompt = srng.integers(0, 128, (4, 8)).astype(np.int32)
+    smesh = build_mesh(MeshSpec(dp=2, tp=2), jax.devices())
+    toks = gpt.generate_spmd(gparams, jnp.asarray(prompt), 5, smesh, dp_shard=True)
+    local_rows = {}
+    for shard in toks.addressable_shards:
+        row0 = shard.index[0].start or 0
+        data = np.asarray(shard.data)
+        for i in range(data.shape[0]):
+            local_rows[row0 + i] = data[i].tolist()
+
     print(
         json.dumps(
             {
@@ -96,6 +119,7 @@ def main() -> None:
                 "global_devices": jax.device_count(),
                 "psum": psum_val,
                 "losses": [round(l, 6) for l in losses],
+                "serving_rows": {str(k): v for k, v in sorted(local_rows.items())},
             }
         ),
         flush=True,

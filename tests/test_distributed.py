@@ -66,3 +66,25 @@ def test_two_process_cluster_psum_and_dp_training():
     # both hosts observed the SAME global loss — the gradient psum crossed
     # the process boundary (a broken data plane would give per-host values)
     assert results[0]["losses"] == results[1]["losses"]
+
+    # multi-host SERVING: the two hosts' addressable dp rows together cover
+    # the whole batch, and every row equals the single-device greedy
+    # reference computed here (TP psums + the vocab all_gather crossed the
+    # process boundary inside the decode program)
+    import jax.numpy as jnp
+
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+
+    gcfg = GPT2Config(
+        vocab_size=128, max_seq=32, n_layer=2, n_head=4, d_model=32, d_ff=64
+    )
+    gpt = GPT2(gcfg)
+    srng = np.random.default_rng(7)  # the workers' serving seed
+    prompt = srng.integers(0, 128, (4, 8)).astype(np.int32)
+    ref = np.asarray(gpt.generate(gpt.init(0), jnp.asarray(prompt), 5))
+    served = {}
+    for r in results.values():
+        served.update({int(k): v for k, v in r["serving_rows"].items()})
+    assert set(served) == {0, 1, 2, 3}
+    for row, toks in served.items():
+        assert toks == ref[row].tolist(), row
