@@ -1212,14 +1212,20 @@ class GPT2:
         top_k: int = 0,
         top_p: float = 0.0,
         seed: int = 0,
+        eos_id: int | None = None,
     ) -> jax.Array:
         """Sample ``max_new_tokens`` continuations. ``temperature == 0`` is
         greedy; otherwise softmax sampling, optionally truncated to the
         ``top_k`` most likely tokens and/or the nucleus holding ``top_p``
-        probability mass. Returns [batch, max_new_tokens]."""
+        probability mass. Returns [batch, max_new_tokens]; with ``eos_id``
+        a row that emits it keeps emitting ``eos_id`` for its remaining
+        positions (shapes stay static — the pad region marks early stop,
+        matching the serving batcher's per-request truncation point)."""
         t = prompt.shape[1]
         self._check_generate_args(t, max_new_tokens, temperature, top_k, top_p)
-        run = self._generate_fn(t, max_new_tokens, float(temperature), int(top_k), float(top_p))
+        run = self._generate_fn(t, max_new_tokens, float(temperature), int(top_k),
+                                float(top_p),
+                                eos_id=None if eos_id is None else int(eos_id))
         return run(params, prompt.astype(jnp.int32), jax.random.PRNGKey(seed))
 
     def _check_generate_args(self, t, max_new_tokens, temperature, top_k, top_p):
@@ -1248,6 +1254,7 @@ class GPT2:
         top_p: float = 0.0,
         seed: int = 0,
         dp_shard: bool = False,
+        eos_id: int | None = None,
     ) -> jax.Array:
         """TP-sharded serving: :meth:`generate` with Megatron-sharded params
         over the mesh's ``tp`` axis (``shard_params(model.param_specs())``
@@ -1278,14 +1285,16 @@ class GPT2:
         if dp_shard and b % dp_size:
             raise ValueError(f"batch {b} not divisible by dp={dp_size} for dp_shard")
         batch_spec = P("dp") if dp_shard else P()
+        eos_id = None if eos_id is None else int(eos_id)  # stable cache key
         key_ = ("spmd", mesh, t, max_new_tokens, float(temperature), int(top_k),
-                float(top_p), dp_shard)
+                float(top_p), dp_shard, eos_id)
         cache = self._gen_cache_dict()
         run = cache.get(key_)
         if run is None:
             raw = self._generate_fn(
                 t, max_new_tokens, float(temperature), int(top_k), float(top_p),
                 tp_axis="tp", jit=False, dp_axis="dp" if dp_shard else None,
+                eos_id=eos_id,
             )
             run = jax.jit(
                 jax.shard_map(
@@ -1306,7 +1315,7 @@ class GPT2:
     def _generate_fn(
         self, prompt_len: int, max_new_tokens: int, temperature: float, top_k: int,
         top_p: float = 0.0, tp_axis: str | None = None, jit: bool = True,
-        dp_axis: str | None = None,
+        dp_axis: str | None = None, eos_id: int | None = None,
     ):
         """Compiled generate program, cached per (prompt_len, max_new,
         temperature, top_k, top_p) so repeated serving calls don't re-trace.
@@ -1315,7 +1324,7 @@ class GPT2:
         dp-sharded run samples per row independently of how the batch is
         split across ranks."""
         key_ = (prompt_len, max_new_tokens, temperature, top_k, top_p, tp_axis, jit,
-                dp_axis)
+                dp_axis, eos_id)
         cache = self._gen_cache_dict()
         if key_ in cache:
             return cache[key_]
@@ -1335,15 +1344,24 @@ class GPT2:
             logits, kv = self.prefill(params, prompt, tp_axis)
             key, sub = jax.random.split(key)
             first = sample_rows(logits, sub)
+            done0 = (
+                first == eos_id if eos_id is not None
+                else jnp.zeros(first.shape, bool)
+            )
 
             def body(carry, _):
-                kv, tok, pos, key = carry
+                kv, tok, pos, key, done = carry
                 logits, kv = self.decode_step(params, kv, tok, pos, tp_axis)
                 key, sub = jax.random.split(key)
                 nxt = sample_rows(logits, sub)
-                return (kv, nxt, pos + 1, key), nxt
+                if eos_id is not None:
+                    # rows past their EOS keep emitting eos_id (static
+                    # shapes — the pad region marks the truncation point)
+                    nxt = jnp.where(done, eos_id, nxt)
+                    done = done | (nxt == eos_id)
+                return (kv, nxt, pos + 1, key, done), nxt
 
-            carry = (kv, first, jnp.asarray(prompt_len, jnp.int32), key)
+            carry = (kv, first, jnp.asarray(prompt_len, jnp.int32), key, done0)
             _, rest = lax.scan(body, carry, None, length=max_new_tokens - 1)
             return jnp.concatenate([first[None], rest], axis=0).T  # [b, max_new]
 

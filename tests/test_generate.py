@@ -86,6 +86,33 @@ def test_top_p_sampling_stays_in_nucleus(model_and_params):
         model.generate(params, prompt, max_new_tokens=2, temperature=0.5, top_p=1.5)
 
 
+def test_generate_eos_pads_after_stop(model_and_params):
+    """``eos_id``: a row that emits it keeps emitting it (static shapes —
+    the pad region marks the truncation point), the prefix is unchanged,
+    and the truncation point matches the serving batcher's."""
+    from dsml_tpu.serving import ContinuousBatcher
+
+    model, params = model_and_params
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, 512, (1, 6)).astype(np.int32)
+    ref = np.asarray(model.generate(params, jnp.asarray(prompt), 8))[0]
+    # stopping is defined by the FIRST occurrence (a degenerate greedy
+    # continuation may repeat the chosen token before position 2)
+    eos = int(ref[2])
+    stop = ref.tolist().index(eos) + 1
+    out = np.asarray(model.generate(params, jnp.asarray(prompt), 8, eos_id=eos))[0]
+    np.testing.assert_array_equal(out[:stop], ref[:stop])
+    assert all(t == eos for t in out[stop:])
+    srv = ContinuousBatcher(model, params, n_slots=1, eos_id=eos,
+                            prompt_buckets=(8,))
+    rid = srv.submit(prompt[0], 8)
+    served = srv.run()[rid]
+    # the batcher stops exactly AT the first eos — same truncation point,
+    # same tokens as generate's pre-pad prefix
+    assert len(served) == stop
+    assert served == list(out[:stop]) and served[-1] == eos
+
+
 def test_generate_rejects_overflow(model_and_params):
     model, params = model_and_params
     prompt = jnp.zeros((1, 120), jnp.int32)
