@@ -201,6 +201,23 @@ def bench_gpt2_decode() -> dict:
         })
     except Exception as e:
         out["gpt2_decode_wq8_error"] = repr(e)[:200]
+    # KV-cache quantization variants: the cache-read side of the decode
+    # bandwidth story. These rows are what validates (or falsifies) the
+    # int4-halves-the-int8-traffic claim on real hardware.
+    for mode in ("int8", "int4"):
+        try:
+            qm = GPT2(dataclasses.replace(cfg, kv_quant=mode))
+
+            def timed_kv(n_new):
+                return _p50_wall(lambda: np.asarray(qm.generate(params, prompt, n_new)))
+
+            per_kv = (timed_kv(n_long) - timed_kv(n_short)) / (n_long - n_short)
+            out.update({
+                f"gpt2_decode_kv{mode[3]}_tokens_per_sec": round(batch / per_kv, 1),
+                f"gpt2_decode_kv{mode[3]}_speedup": round(per_step / per_kv, 2),
+            })
+        except Exception as e:
+            out[f"gpt2_decode_kv{mode[3]}_error"] = repr(e)[:200]
     return out
 
 

@@ -74,7 +74,7 @@ class GPT2Config:
     # decode read bandwidth (the cache read IS the decode bottleneck at
     # long context). Dequantized at the attention boundary; prefill/decode/
     # decode_step_slots and both model families share the one code path
-    kv_quant: bool = False
+    kv_quant: bool | str = False  # False | True/"int8" | "int4"
 
     @staticmethod
     def small() -> "GPT2Config":
@@ -844,41 +844,87 @@ class GPT2:
             for _ in range(cfg.n_layer)
         ]
 
+    def _kv_mode(self) -> str | None:
+        """None | "int8" | "int4" — the normalized ``config.kv_quant``
+        (True is "int8" for back-compat). Unknown strings fail loudly
+        rather than silently serving an unquantized cache."""
+        kq = self.config.kv_quant
+        if not kq:
+            return None
+        if kq is True or kq == "int8":
+            return "int8"
+        if kq == "int4":
+            return "int4"
+        raise ValueError(
+            f"unknown kv_quant mode {kq!r}; choose False, True/'int8', or 'int4'"
+        )
+
     def _cache_entry(self, batch: int, n_heads: int) -> dict:
         cfg = self.config
         hd = cfg.d_model // cfg.n_head
-        shape = (batch, n_heads, cfg.max_seq, hd)
-        if cfg.kv_quant:
+        mode = self._kv_mode()
+        if mode:
+            if mode == "int4":
+                if hd % 2:
+                    raise ValueError(f"kv_quant='int4' needs an even head_dim, got {hd}")
+                shape = (batch, n_heads, cfg.max_seq, hd // 2)  # 2 nibbles/byte
+                dt = jnp.uint8
+            else:
+                shape = (batch, n_heads, cfg.max_seq, hd)
+                dt = jnp.int8
             return {
-                "k": jnp.zeros(shape, jnp.int8),
+                "k": jnp.zeros(shape, dt),
                 "k_s": jnp.zeros((*shape[:3], 1), jnp.float32),
-                "v": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, dt),
                 "v_s": jnp.zeros((*shape[:3], 1), jnp.float32),
             }
         dt = jnp.dtype(cfg.dtype)
+        shape = (batch, n_heads, cfg.max_seq, hd)
         return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
-    @staticmethod
-    def _kv_quantize(x):
-        """[b, h, s, hd] → (int8 values, f32 scale [b, h, s, 1]): symmetric
-        absmax per position — each token's K/V row quantizes independently,
-        so cache writes never touch other rows' scales."""
-        a = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    def _kv_quantize(self, x):
+        """[b, h, s, hd] → (quantized values, f32 scale [b, h, s, 1]):
+        symmetric absmax per position — each token's K/V row quantizes
+        independently, so cache writes never touch other rows' scales.
+        int8 stores values directly; int4 packs two offset nibbles per
+        byte (q+8 in [1, 15], even channel in the high nibble)."""
+        x32 = x.astype(jnp.float32)
+        a = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+        if self._kv_mode() == "int4":
+            s = jnp.where(a > 0, a / 7.0, 1.0)
+            q = jnp.clip(jnp.round(x32 / s), -7, 7).astype(jnp.int32) + 8
+            # channel HALVES pack contiguously (high nibbles = channels
+            # [0, hd/2), low = [hd/2, hd)) so the unpack is a concat of two
+            # shift/mask ops — fusion-friendly, no interleaving gather that
+            # would materialize a full-width cache copy per step
+            half = q.shape[-1] // 2
+            packed = (q[..., :half] << 4 | q[..., half:]).astype(jnp.uint8)
+            return packed, s
         s = jnp.where(a > 0, a / 127.0, 1.0)
-        return jnp.round(x.astype(jnp.float32) / s).astype(jnp.int8), s
+        return jnp.round(x32 / s).astype(jnp.int8), s
 
     def _cache_write(self, c: dict, kc, vc, write) -> dict:
         """Write new K/V rows through ``write(cache_array, new_rows)`` —
         the ONE place the quantized and plain layouts branch. ``write`` is
         the caller's placement (full-prefix ``dynamic_update_slice``, shared
         decode position, or the per-slot batched scatter); scale tensors ride
-        the same placement with their trailing dim of 1."""
-        if self.config.kv_quant:
+        the same placement with their trailing dim of 1 (int4's packed
+        values ride it with trailing dim hd/2)."""
+        if self._kv_mode():
             kq, ks = self._kv_quantize(kc)
             vq, vs = self._kv_quantize(vc)
             return {"k": write(c["k"], kq), "k_s": write(c["k_s"], ks),
                     "v": write(c["v"], vq), "v_s": write(c["v_s"], vs)}
         return {"k": write(c["k"], kc), "v": write(c["v"], vc)}
+
+    @staticmethod
+    def _unpack_int4(p):
+        """[..., hd/2] packed nibbles → [..., hd] int8 in [-7, 7] (channel
+        halves are contiguous — see :meth:`_kv_quantize` — so this is a
+        concat of two elementwise ops, not an interleaving gather)."""
+        hi = (p >> 4).astype(jnp.int8) - 8
+        lo = (p & 0xF).astype(jnp.int8) - 8
+        return jnp.concatenate([hi, lo], axis=-1)
 
     def _cache_attn_inputs(self, c: dict):
         """(ck, cv, k_s, v_s) for :meth:`_decode_attention` — scales are
@@ -886,8 +932,14 @@ class GPT2:
         dots as-is (the int8→float convert feeds the dot operand, which XLA
         fuses, instead of materializing a dequantized full-width cache
         copy); the per-position scales, constant along ``hd``, fold in
-        AFTER each dot — mathematically identical to dequantize-then-dot."""
-        if self.config.kv_quant:
+        AFTER each dot — mathematically identical to dequantize-then-dot.
+        int4 unpacks its nibbles to the same int8 form first (fused the
+        same way — the packed cache is what HBM traffic pays for)."""
+        mode = self._kv_mode()
+        if mode == "int4":
+            return (self._unpack_int4(c["k"]), self._unpack_int4(c["v"]),
+                    c["k_s"], c["v_s"])
+        if mode:
             return c["k"], c["v"], c["k_s"], c["v_s"]
         return c["k"], c["v"], None, None
 

@@ -69,7 +69,7 @@ class LlamaConfig:
     pp_interleave: int = 1
     # int8 KV cache with per-position scales (see GPT2Config.kv_quant) —
     # stacks with the GQA cache's kv-heads-only memory win
-    kv_quant: bool = False
+    kv_quant: bool | str = False  # False | True/"int8" | "int4"
 
     @staticmethod
     def tinyllama_1b() -> "LlamaConfig":

@@ -310,6 +310,51 @@ def test_kv_quant_serving_is_scheduling_independent():
         assert out[rid] == ref, rid
 
 
+def test_kv_quant_int4_memory_closeness_and_scheduling():
+    """kv_quant='int4': the cache packs two nibbles per byte (~half the
+    int8 cache, ~8x below f32 K/V modulo the scale rows), teacher-forced
+    decode logits stay within the coarser 4-bit noise, the
+    scheduling-independence contract stays EXACT, and an unknown mode
+    string fails loudly."""
+    import dataclasses
+
+    from dsml_tpu.serving import ContinuousBatcher
+
+    cfg = GPT2Config.tiny()
+    exact = GPT2(cfg)
+    q8 = GPT2(dataclasses.replace(cfg, kv_quant="int8"))
+    q4 = GPT2(dataclasses.replace(cfg, kv_quant="int4"))
+    params = exact.init(14)
+    rng = np.random.default_rng(14)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 12)), jnp.int32)
+
+    b8, b4 = _cache_bytes(q8.init_cache(2)), _cache_bytes(q4.init_cache(2))
+    assert b4 < b8  # packed values halve; the f32 scale rows are shared
+    assert q4.init_cache(2)[0]["k"].dtype == jnp.uint8
+
+    full = np.asarray(exact.apply(params, toks))
+    logits, cache = jax.jit(q4.prefill)(params, toks[:, :5])
+    step = jax.jit(q4.decode_step)
+    for pos in range(5, 12):
+        logits, cache = step(params, cache, toks[:, pos], jnp.asarray(pos, jnp.int32))
+        ref = full[:, pos]
+        err = np.abs(np.asarray(logits) - ref).max()
+        # 4-bit absmax-per-row: ~16x coarser quantum than int8
+        assert err < 0.35 * np.abs(ref).max() + 0.35, (pos, err)
+
+    prompts = [rng.integers(0, cfg.vocab_size, (l,)).astype(np.int32)
+               for l in (6, 14)]
+    srv = ContinuousBatcher(q4, params, n_slots=2, prompt_buckets=(8, 16))
+    rids = [srv.submit(p, 5) for p in prompts]
+    out = srv.run()
+    for rid, p in zip(rids, prompts):
+        ref = [int(t) for t in np.asarray(q4.generate(params, p[None, :], 5))[0]]
+        assert out[rid] == ref, rid
+
+    with pytest.raises(ValueError, match="kv_quant"):
+        GPT2(dataclasses.replace(cfg, kv_quant="int2")).init_cache(1)
+
+
 def test_kv_quant_llama_gqa():
     """Llama: int8 cache stacks with the kv-heads-only GQA cache; decode
     logits stay close to the exact-cache path."""
