@@ -158,6 +158,7 @@ class GPT2:
 
     def __init__(self, config: GPT2Config | None = None):
         self.config = config or GPT2Config.small()
+        self._kv_mode()  # a bad kv_quant string fails at construction
 
     # ---- params ---------------------------------------------------------------
 

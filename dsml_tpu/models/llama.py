@@ -151,6 +151,7 @@ class Llama(GPT2):
 
     def __init__(self, config: LlamaConfig | None = None):
         self.config = config or LlamaConfig.tinyllama_1b()
+        self._kv_mode()  # a bad kv_quant string fails at construction
 
     # ---- params ---------------------------------------------------------------
 

@@ -38,6 +38,8 @@ class GenerateConfig(Config):
     top_k: int = field(32, help="0 = full distribution")
     top_p: float = field(0.0, help="nucleus sampling mass (0 = off)")
     seed: int = field(0, help="sampling seed")
+    eos: int = field(-1, help="stop token id (-1 = none); rows pad with it after stopping")
+    speculative: int = field(0, help="greedy prompt-lookup speculative decode with this verify window (>=2; forces temperature 0, single-device)")
     tp: int = field(1, help="tensor-parallel serving: shard heads/vocab/KV-cache over this many devices (generate_spmd)")
 
 
@@ -79,8 +81,22 @@ def main(argv=None):
         top_k=cfg.top_k,
         top_p=cfg.top_p,
         seed=cfg.seed,
+        eos_id=None if cfg.eos < 0 else cfg.eos,
     )
-    if cfg.tp > 1:
+    if cfg.speculative:
+        if cfg.tp > 1:
+            raise SystemExit("--speculative is single-device; drop --tp")
+        if cfg.eos >= 0:
+            raise SystemExit("--speculative has no eos support; drop --eos")
+        from dsml_tpu.models.speculative import generate_speculative
+
+        out, calls = generate_speculative(
+            model, params, prompt, cfg.max_new_tokens,
+            window=cfg.speculative, return_calls=True,
+        )
+        log.info("speculative: %d verify calls for %d tokens (%.2f tokens/call)",
+                 calls, cfg.max_new_tokens, cfg.max_new_tokens / max(calls, 1))
+    elif cfg.tp > 1:
         # TP-sharded serving: Megatron-sharded params, per-rank KV-cache
         # shard, token-identical to the single-device path
         import jax
