@@ -186,17 +186,53 @@ def shard_batches(
     batch_size: int,
     seed: int | None = None,
     drop_remainder: bool = True,
+    native: bool | None = None,
 ):
     """Yield (x_batch, y_batch) host batches, shuffled per epoch. The batch is
     the GLOBAL batch; the mesh sharding (``P('dp')`` on axis 0) splits it
     across data-parallel ranks at dispatch — the real data sharding the
     reference lacked (its 'DP' shipped identical full batches everywhere,
-    SURVEY.md §2.3)."""
+    SURVEY.md §2.3).
+
+    ``native`` routes the (large) x-row gather through the C++
+    background-thread loader (``runtime.native.NativePrefetcher``) so it
+    overlaps device compute at the native layer; ``None`` auto-detects,
+    ``False`` forces the numpy path. Values are identical either way
+    (tests pin it) — labels stay a numpy gather (tiny)."""
     n = x.shape[0]
     idx = np.arange(n)
     if seed is not None:
         np.random.default_rng(seed).shuffle(idx)
     end = (n // batch_size) * batch_size if drop_remainder else n
+    n_full = end // batch_size
+    # SETUP only inside the try: once batches start yielding, a native
+    # error must propagate — falling back mid-stream would restart the
+    # epoch from batch 0 and silently feed duplicated data
+    pf = batch_idx = None
+    if native is not False and n_full > 0:
+        try:
+            from dsml_tpu.runtime import native as nat
+
+            if nat.available():
+                batch_idx = idx[: n_full * batch_size].reshape(
+                    n_full, batch_size
+                ).astype(np.int32)
+                pf = nat.NativePrefetcher(x, batch_idx, depth=2)
+        except Exception:
+            if native:  # explicitly requested — don't silently degrade
+                raise
+            pf = None
+    if native and pf is None:
+        raise RuntimeError(
+            "native=True but the native runtime is unavailable (no compiler?)"
+        )
+    if pf is not None:
+        for b, xb in enumerate(pf):
+            yield xb, y[batch_idx[b]]
+        if not drop_remainder and end > n_full * batch_size:
+            sel = idx[n_full * batch_size : end]
+            yield x[sel], y[sel]
+        return
     for start in range(0, end, batch_size):
         sel = idx[start : start + batch_size]
         yield x[sel], y[sel]

@@ -88,6 +88,11 @@ def main(argv=None):
             raise SystemExit("--speculative is single-device; drop --tp")
         if cfg.eos >= 0:
             raise SystemExit("--speculative has no eos support; drop --eos")
+        if cfg.temperature or cfg.top_k or cfg.top_p:
+            # the defaults are non-greedy, so say out loud that speculative
+            # verification is greedy-only rather than silently ignoring them
+            log.info("speculative decode is greedy-only: ignoring "
+                     "temperature/top_k/top_p")
         from dsml_tpu.models.speculative import generate_speculative
 
         out, calls = generate_speculative(

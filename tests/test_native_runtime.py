@@ -124,6 +124,23 @@ def test_prefetcher_rejects_bad_rows_and_shapes():
         list(pf)
 
 
+def test_shard_batches_native_matches_numpy():
+    """The native-gather route through shard_batches is value-identical to
+    the numpy path (same shuffle, same batches, same tail handling)."""
+    from dsml_tpu.utils.data import shard_batches
+
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((50, 7)).astype(np.float32)
+    y = rng.integers(0, 5, 50).astype(np.int32)
+    for drop in (True, False):
+        ref = list(shard_batches(x, y, 8, seed=3, drop_remainder=drop, native=False))
+        got = list(shard_batches(x, y, 8, seed=3, drop_remainder=drop, native=True))
+        assert len(got) == len(ref)
+        for (xr, yr), (xg, yg) in zip(ref, got):
+            np.testing.assert_array_equal(xg, xr)
+            np.testing.assert_array_equal(yg, yr)
+
+
 def test_prefetcher_drains_valid_batches_before_error():
     """Delivery up to the bad batch is deterministic no matter how far
     ahead the producer thread ran: valid batches drain first, THEN the
