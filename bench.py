@@ -710,8 +710,9 @@ def bench_ring_allreduce() -> dict:
     p50 = _differenced_ring_p50(mesh, "ring")
     # naive (gather-everything) baseline on the same payload — the 83 ms vs
     # 8 ms story the reference benchmarked (BASELINE.md), now from real
-    # collectives
+    # collectives — plus the bidirectional ring (full-duplex ICI)
     naive_p50 = _differenced_ring_p50(mesh, "naive")
+    ring2_p50 = _differenced_ring_p50(mesh, "ring2")
 
     # (b) the full proto-API path the gRPC coordinator pays: H2D + ring + D2H
     # (np.asarray forces the D2H copy; block_until_ready alone would not)
@@ -748,6 +749,7 @@ def bench_ring_allreduce() -> dict:
 
     out = {
         "allreduce_ring_p50_ms": round(p50, 3),
+        "allreduce_ring2_p50_ms": round(ring2_p50, 3),
         "allreduce_naive_p50_ms": round(naive_p50, 3),
         "allreduce_e2e_p50_ms": round(e2e_p50, 3),
         "allreduce_e2e_h2d_p50_ms": round(h2d_p50, 3),
@@ -784,6 +786,7 @@ def _virtual8_main() -> None:
 
     mesh = build_mesh(MeshSpec(dp=8), jax.devices()[:8])
     ring = _differenced_ring_p50(mesh, "ring", reps=20, r_hi=10)
+    ring2 = _differenced_ring_p50(mesh, "ring2", reps=20, r_hi=10)
     naive = _differenced_ring_p50(mesh, "naive", reps=20, r_hi=10)
 
     # full proto-API path: gRPC client → coordinator → zero-copy HBM ring.
@@ -829,6 +832,7 @@ def _virtual8_main() -> None:
 
     out = {
         "ring_ms": round(ring, 3),
+        "ring2_ms": round(ring2, 3),
         "naive_ms": round(naive, 3),
         "wire_e2e_ms": wire_e2e,
     }
@@ -860,6 +864,7 @@ def bench_ring_virtual8() -> dict:
         res = json.loads(proc.stdout.strip().splitlines()[-1])
         return {
             "allreduce_virtual8_ring_p50_ms": res["ring_ms"],
+            "allreduce_virtual8_ring2_p50_ms": res.get("ring2_ms"),
             "allreduce_virtual8_naive_p50_ms": res["naive_ms"],
             "allreduce_virtual8_wire_e2e_p50_ms": res.get("wire_e2e_ms"),
             "allreduce_virtual8_note": "8-device virtual CPU mesh (harness proof, not ICI)",

@@ -54,7 +54,7 @@ class CoordinatorConfig(Config):
     probe_timeout_s: float = cfg_field(2.0, help="per-device health probe timeout (reference: 2s)")
     dial_retries: int = cfg_field(3, help="CommInit dial attempts per device (reference: 3)")
     dial_backoff_s: float = cfg_field(0.5, help="sleep between dial attempts (reference: 500ms)")
-    ring_algorithm: str = cfg_field("ring", help="AllReduceRing algorithm: ring|xla|naive|auto (auto = payload/axis-aware latency-vs-bandwidth selection)")
+    ring_algorithm: str = cfg_field("ring", help="AllReduceRing algorithm: ring|ring2|xla|naive|auto (ring2 = bidirectional full-duplex ring; auto = payload/axis-aware latency-vs-bandwidth selection)")
     elastic: bool = cfg_field(
         False,
         help="on device failure, re-rank the surviving devices and keep the "

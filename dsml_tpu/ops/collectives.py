@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     "ReduceOp",
     "ring_all_reduce",
+    "ring2_all_reduce",
     "naive_all_reduce",
     "all_reduce",
     "hierarchical_all_reduce",
@@ -94,62 +95,89 @@ def _ring_perm(n: int) -> list[tuple[int, int]]:
 # ---------------------------------------------------------------------------
 
 
-def ring_all_reduce(x: jax.Array, axis_name: str, op: ReduceOp = ReduceOp.SUM) -> jax.Array:
-    """Ring all-reduce of ``x`` (same shape on every rank) across ``axis_name``.
+def _ring_all_reduce_impl(x: jax.Array, axis_name: str, op: ReduceOp, signs: tuple) -> jax.Array:
+    """THE ring schedule, generalized over directions: the payload splits
+    into ``len(signs)`` parts, each running the 2(n−1)-step
+    scatter-reduce/all-gather schedule around the ring in its own
+    direction (sign +1 = the reference's forward schedule, send segment
+    ``(rank−step) mod n`` / receive ``(rank−step−1) mod n``,
+    ``gpu_coordinator_server.go:393-404``; sign −1 = the same schedule
+    under the rank relabeling r → −r mod n). Each step issues every
+    direction's hop back-to-back so the scheduler can overlap them.
 
-    Scatter-reduce for n-1 steps, then all-gather for n-1 steps — the same
-    2(n-1) schedule and segment arithmetic as the reference
-    (send segment ``(rank-step) mod n``, receive ``(rank-step-1) mod n``,
-    ``gpu_coordinator_server.go:393-404``) — but as a single XLA program whose
-    sends are ``lax.ppermute`` hops over ICI and whose combiner is dtype-aware.
-
-    Works on any shape/dtype; the buffer is flattened and zero-padded up to a
-    multiple of n (like the reference, gpu_coordinator_server.go:297-334;
-    pad positions only ever combine with other ranks' pad positions and are
-    sliced off before return, so the pad value is immaterial).
-    """
+    Works on any shape/dtype; the flattened buffer zero-pads up to a
+    multiple of ``len(signs)·n`` (like the reference,
+    gpu_coordinator_server.go:297-334; pad positions only ever combine
+    with other ranks' pad positions and are sliced off before return).
+    Small ints accumulate in a wider type so SUM across ranks can't wrap
+    (the reference's uint8 wraparound bug, SURVEY.md §8.2)."""
     op = ReduceOp(op)
     n = _axis_size(axis_name)
     if n == 1:
         return x
 
     orig_shape, orig_dtype = x.shape, x.dtype
-    # Accumulate small ints in a wider type so SUM across ranks can't wrap
-    # (the reference's uint8 wraparound bug, SURVEY.md §8.2).
-    acc_dtype = jnp.promote_types(orig_dtype, jnp.int32) if jnp.issubdtype(orig_dtype, jnp.integer) else orig_dtype
+    acc_dtype = (
+        jnp.promote_types(orig_dtype, jnp.int32)
+        if jnp.issubdtype(orig_dtype, jnp.integer) else orig_dtype
+    )
     flat = x.astype(acc_dtype).reshape(-1)
     size = flat.shape[0]
-    padded = -(-size // n) * n  # ceil to multiple of n
+    k = len(signs)
+    padded = -(-size // (k * n)) * (k * n)
     if padded != size:
         flat = jnp.pad(flat, (0, padded - size))
-    seg = padded // n
-    buf = flat.reshape(n, seg)
+    seg = padded // (k * n)
+    part = padded // k
+    bufs = [flat[i * part : (i + 1) * part].reshape(n, seg) for i in range(k)]
 
     rank = lax.axis_index(axis_name)
-    perm = _ring_perm(n)
+    perms = {+1: _ring_perm(n), -1: [(i, (i - 1) % n) for i in range(n)]}
 
-    # Scatter-reduce: after step t, segment (rank - t - 1) mod n holds the
-    # partial reduction of t+2 ranks' contributions.
-    for step in range(n - 1):
-        send_idx = (rank - step) % n
-        recv_idx = (rank - step - 1) % n
+    def hop(buf, sign, send_idx, recv_idx, combine):
         chunk = lax.dynamic_index_in_dim(buf, send_idx, axis=0, keepdims=False)
-        recv = lax.ppermute(chunk, axis_name, perm)
-        combined = op.combine(lax.dynamic_index_in_dim(buf, recv_idx, 0, keepdims=False), recv)
-        buf = lax.dynamic_update_index_in_dim(buf, combined, recv_idx, axis=0)
+        recv = lax.ppermute(chunk, axis_name, perms[sign])
+        resident = lax.dynamic_index_in_dim(buf, recv_idx, 0, keepdims=False)
+        new = combine(resident, recv) if combine is not None else recv
+        return lax.dynamic_update_index_in_dim(buf, new, recv_idx, axis=0)
 
+    # Scatter-reduce: after step t, segment (rank − sign·(t+1)) mod n holds
+    # the partial reduction of t+2 ranks' contributions.
+    for step in range(n - 1):
+        bufs = [
+            hop(b, s, (rank - s * step) % n, (rank - s * (step + 1)) % n, op.combine)
+            for b, s in zip(bufs, signs)
+        ]
     # All-gather: circulate each fully-reduced segment around the ring.
     for step in range(n - 1):
-        send_idx = (rank - step + 1) % n
-        recv_idx = (rank - step) % n
-        chunk = lax.dynamic_index_in_dim(buf, send_idx, axis=0, keepdims=False)
-        recv = lax.ppermute(chunk, axis_name, perm)
-        buf = lax.dynamic_update_index_in_dim(buf, recv, recv_idx, axis=0)
+        bufs = [
+            hop(b, s, (rank - s * (step - 1)) % n, (rank - s * step) % n, None)
+            for b, s in zip(bufs, signs)
+        ]
 
-    out = buf.reshape(-1)[:size]
+    out = bufs[0].reshape(-1) if k == 1 else jnp.concatenate([b.reshape(-1) for b in bufs])
+    out = out[:size]
     if op == ReduceOp.AVG:
         out = out / n
     return out.reshape(orig_shape).astype(orig_dtype)
+
+
+def ring_all_reduce(x: jax.Array, axis_name: str, op: ReduceOp = ReduceOp.SUM) -> jax.Array:
+    """Ring all-reduce of ``x`` (same shape on every rank) across
+    ``axis_name`` — the reference's forward 2(n−1)-step schedule as one
+    XLA program whose sends are ``lax.ppermute`` hops over ICI and whose
+    combiner is dtype-aware (see :func:`_ring_all_reduce_impl`)."""
+    return _ring_all_reduce_impl(x, axis_name, op, (+1,))
+
+
+def ring2_all_reduce(x: jax.Array, axis_name: str, op: ReduceOp = ReduceOp.SUM) -> jax.Array:
+    """BIDIRECTIONAL ring all-reduce: two half-payloads run the ring
+    schedule in OPPOSITE directions simultaneously — TPU ICI links are
+    full duplex, so the reverse hops ride otherwise-idle capacity and
+    each direction moves only S/2 bytes: ~2× the unidirectional ring's
+    bandwidth at the same step count. Exactness vs
+    :func:`ring_all_reduce` is pinned in tests for every ReduceOp."""
+    return _ring_all_reduce_impl(x, axis_name, op, (+1, -1))
 
 
 def naive_all_reduce(x: jax.Array, axis_name: str, op: ReduceOp = ReduceOp.SUM) -> jax.Array:
@@ -211,6 +239,8 @@ def all_reduce(
                 the default for training code.
     ``ring``  — the explicit 2(n-1)-step ring (honest ring-latency numbers,
                 BASELINE.md metric).
+    ``ring2`` — bidirectional ring: two half-payloads in opposite
+                directions per step (full-duplex ICI → ~2× ring bandwidth).
     ``naive`` — gather+reduce baseline.
     ``auto``  — pick ring vs naive from the static payload size and axis
                 size (:func:`auto_all_reduce_algorithm`): latency-optimal
@@ -225,6 +255,8 @@ def all_reduce(
         )
     if algorithm == "ring":
         return ring_all_reduce(x, axis_name, op)
+    if algorithm == "ring2":
+        return ring2_all_reduce(x, axis_name, op)
     if algorithm == "naive":
         return naive_all_reduce(x, axis_name, op)
     if algorithm != "xla":

@@ -46,9 +46,11 @@ def _np_reduce(xs, op):
 
 
 @pytest.mark.parametrize("op", list(C.ReduceOp))
-@pytest.mark.parametrize("algorithm", ["ring", "naive", "xla", "auto"])
+@pytest.mark.parametrize("algorithm", ["ring", "ring2", "naive", "xla", "auto"])
 def test_all_reduce_all_ops(mesh8, op, algorithm):
-    xs = _stack(8, (33,), np.float32)  # 33 not divisible by 8 → exercises padding
+    # 33 not divisible by 8 → exercises padding (ring2 additionally pads
+    # each of its two directional halves to a segment multiple)
+    xs = _stack(8, (33,), np.float32)
     fn = lambda x: C.all_reduce(x[0], "dev", op, algorithm)[None]
     out = _run_collective(mesh8, fn, xs)
     expected = _np_reduce(xs, op)
@@ -57,12 +59,14 @@ def test_all_reduce_all_ops(mesh8, op, algorithm):
 
 
 @pytest.mark.parametrize("dtype", [np.float32, np.int32, np.uint8, jnp.bfloat16])
-def test_ring_dtypes(mesh8, dtype):
+@pytest.mark.parametrize("ring_fn", [C.ring_all_reduce, C.ring2_all_reduce])
+def test_ring_dtypes(mesh8, dtype, ring_fn):
     """Dtype-aware reduction — fixes the byte-wise uint8 add of the reference
     (gpu_coordinator_server.go:540-543, SURVEY.md §8.2). uint8 sums that would
-    wrap in the reference are exact here (accumulated wide, cast back)."""
+    wrap in the reference are exact here (accumulated wide, cast back) —
+    in BOTH ring directions' accumulation paths."""
     xs = _stack(8, (16, 5), dtype)
-    fn = lambda x: C.ring_all_reduce(x[0], "dev", C.ReduceOp.SUM)[None]
+    fn = lambda x: ring_fn(x[0], "dev", C.ReduceOp.SUM)[None]
     out = _run_collective(mesh8, fn, xs)
     wide = np.asarray(xs, dtype=np.float64).sum(axis=0)
     got = np.asarray(out[0], dtype=np.float64)
