@@ -7,6 +7,7 @@ from dsml_tpu.ops.collectives import (  # noqa: F401
     all_to_all,
     naive_all_reduce,
     reduce_scatter,
+    ring2_all_reduce,
     ring_all_reduce,
 )
 from dsml_tpu.ops.flash import (  # noqa: F401
