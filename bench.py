@@ -326,7 +326,7 @@ def _gpt2_train_throughput(
         "batch": batch,
         "seq": seq,
         "dtype": "bfloat16",
-        "attn": "pallas_flash_512",
+        "attn": "pallas_flash_auto",  # swept blocks: 512x512 short, 512x1024 at kv>=4096
         "donate": True,
         "compile_s": round(compile_s, 1),
         "timing_mode": timing_mode,
@@ -539,9 +539,76 @@ def bench_serving() -> dict:
             "is what the ratio measures when dispatch is cheap"
         ),
     }
+    rows.update(_bench_serving_turbo(model, params, cfg, on_tpu))
     rows.update(_bench_serving_llama_kvquant(on_tpu))
     rows.update(_bench_speculative(model, params, on_tpu))
     return rows
+
+
+def _bench_serving_turbo(model, params, cfg, on_tpu: bool) -> dict:
+    """Turbo-tick escalation on a LONG-GENERATION workload (short prompts,
+    large budgets — the shape where steady-state decode dominates and the
+    per-tick dispatch RTT is the bottleneck): the same drain timed with
+    turbo off vs on. The streaming row above keeps small mixed budgets
+    where turbo rarely engages; this row is the one it exists for."""
+    import numpy as np
+
+    from dsml_tpu.serving import ContinuousBatcher
+
+    if on_tpu:
+        # n_requests == n_slots: everyone admits in the first tick and the
+        # rest of the drain is pure steady-state decode — the regime the
+        # escalation targets (with a standing queue the admission cadence
+        # correctly keeps turbo off)
+        n_requests, n_slots, quantum, factor = 8, 8, 16, 4
+        new_lo, new_hi = 128, 192
+    else:
+        n_requests, n_slots, quantum, factor = 4, 4, 4, 4
+        new_lo, new_hi = 24, 40
+    rng = np.random.default_rng(7)
+    max_prompt = min(64, cfg.max_seq - new_hi - 1)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, (int(l),)).astype(np.int32)
+        for l in rng.integers(8, max_prompt + 1, n_requests)
+    ]
+    budgets = rng.integers(new_lo, new_hi + 1, n_requests).tolist()
+
+    def drain(turbo):
+        srv = ContinuousBatcher(
+            model, params, n_slots=n_slots, prompt_buckets=(max(64, max_prompt),),
+            decode_quantum=quantum, turbo_factor=turbo,
+        )
+        # warmup must compile BOTH decode programs: after the prefill token
+        # the remaining budget is quantum*(turbo+1), so the first tick
+        # escalates (turbo path) and the leftover quantum drains through a
+        # PLAIN tick — without it the plain program would JIT mid-timed-run
+        srv.submit(prompts[0], quantum * (max(turbo, 1) + 1) + 1)
+        srv.run()
+        srv.collect()
+        p0, t0c = srv.n_plain_ticks, srv.n_turbo_ticks
+        for p, n in zip(prompts, budgets):
+            srv.submit(p, int(n))
+        t0 = time.monotonic()
+        out = srv.run()
+        wall = time.monotonic() - t0
+        toks = sum(len(t) for t in out.values())
+        return toks / wall, srv.n_plain_ticks - p0, srv.n_turbo_ticks - t0c
+
+    try:
+        base_tps, base_plain, _ = drain(0)
+        turbo_tps, turbo_plain, turbo_ticks = drain(factor)
+    except Exception as e:  # never fail the whole serving section on this row
+        return {"serving_turbo_error": repr(e)[:200]}
+    return {
+        "serving_longgen_tokens_per_sec": round(base_tps, 1),
+        "serving_longgen_turbo_tokens_per_sec": round(turbo_tps, 1),
+        "serving_turbo_speedup": round(turbo_tps / base_tps, 2),
+        "serving_turbo_factor": factor,
+        "serving_turbo_dispatches": turbo_ticks,
+        "serving_turbo_plain_dispatches": turbo_plain,
+        "serving_longgen_base_dispatches": base_plain,
+        "serving_longgen_budget_range": [new_lo, new_hi],
+    }
 
 
 def _bench_speculative(model, params, on_tpu: bool) -> dict:

@@ -124,6 +124,23 @@ class ContinuousBatcher:
     or without the cache (prefix rows attend only within the prefix under
     causality, so they equal the full prefill's — pinned in tests).
 
+    ``turbo_factor`` — when >= 2, a SECOND decode program with quantum
+    ``decode_quantum * turbo_factor`` is compiled, and a scheduler tick
+    escalates to it whenever the batcher is in steady-state decode: the
+    queue is empty, no chunked admission is mid-flight, and at least one
+    active request has the full turbo quantum's budget remaining (a slot
+    that finishes mid-tick would have idled under plain ticks too — the
+    queue is empty — so escalation wastes nothing a plain schedule would
+    have used; an EOS or budget hit mid-turbo retires the slot and
+    discards the tail, exactly as a plain quantum does). Dispatch cost
+    drops ``turbo_factor``× in steady state while admission latency keeps
+    the BASE quantum's granularity — the adaptive answer to the per-tick
+    host RTT that a fixed large quantum would buy only by slowing every
+    admission. Tokens are IDENTICAL with turbo on or off (the sampler
+    folds (request, absolute step) — pinned in tests). A request submitted
+    DURING a turbo tick waits out that tick (the trade-off vs the base
+    quantum's admission cadence).
+
     ``speculative_window`` — when >= 2, each decode tick runs PROMPT-LOOKUP
     SPECULATIVE decoding across all slots: every active slot drafts
     window−1 tokens from the most recent n-gram match in its own history
@@ -151,6 +168,7 @@ class ContinuousBatcher:
         seed: int = 0,
         prompt_buckets: tuple = (32, 64, 128, 256, 512, 1024),
         decode_quantum: int = 1,
+        turbo_factor: int = 0,
         prefill_chunk: int = 0,
         speculative_window: int = 0,
         speculative_ngram: int = 2,
@@ -204,6 +222,30 @@ class ContinuousBatcher:
         if decode_quantum < 1:
             raise ValueError(f"decode_quantum must be >= 1, got {decode_quantum}")
         self.decode_quantum = decode_quantum
+        if turbo_factor < 0 or turbo_factor == 1:
+            raise ValueError(
+                f"turbo_factor must be 0 (off) or >= 2, got {turbo_factor}"
+            )
+        if turbo_factor and speculative_window:
+            raise ValueError(
+                "turbo_factor composes with plain quanta only; the speculative "
+                "window sets its own per-tick budget"
+            )
+        if turbo_factor and decode_quantum * turbo_factor >= cfg.max_seq:
+            # submit() enforces len(prompt) + max_new <= max_seq with a
+            # nonempty prompt, so remaining budget tops out at max_seq - 1:
+            # a turbo quantum at or past max_seq could never engage and the
+            # second program's compile would be pure waste
+            raise ValueError(
+                f"turbo quantum {decode_quantum * turbo_factor} >= "
+                f"max_seq={cfg.max_seq} — no request could ever have that much "
+                "budget remaining"
+            )
+        self.turbo_factor = int(turbo_factor)
+        # dispatch counters: observability for tests and servers (how often
+        # the turbo escalation actually engages)
+        self.n_plain_ticks = 0
+        self.n_turbo_ticks = 0
         if speculative_window:
             if speculative_window < 2 or speculative_ngram < 1:
                 raise ValueError(
@@ -231,29 +273,39 @@ class ContinuousBatcher:
 
         from dsml_tpu.models.gpt2 import sample_token_logits
 
-        def decode_k(p, c, t, pos, base_keys, steps_done):
-            """k chained slot-decode steps + sampling in ONE program.
-            ``base_keys`` [B, 2] per-slot PRNG keys (rid-derived),
-            ``steps_done`` [B] tokens already emitted per request (the
-            sampler's step index). Positions clamp at max_seq-1: slots that
+        def make_decode_k(k):
+            """Build the k-chained slot-decode + sampling program (ONE
+            dispatch). ``base_keys`` [B, 2] per-slot PRNG keys
+            (rid-derived), ``steps_done`` [B] tokens already emitted per
+            request (the sampler's step index — folding the ABSOLUTE step
+            keeps the sampled stream identical for any k, including the
+            turbo escalation). Positions clamp at max_seq-1: slots that
             retire mid-quantum keep writing their (dead) last row, which
             the next prefill overwrites."""
 
-            def body(carry, i):
-                c, t, pos = carry
-                logits, c = model.decode_step_slots(p, c, t, pos, tp_axis)
-                if temperature <= 0.0:
-                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                else:
-                    def one(row, key, n_done):
-                        k2 = jax.random.fold_in(key, n_done + i)
-                        return sample_token_logits(row, k2, temperature, top_k, top_p)
+            def decode_k(p, c, t, pos, base_keys, steps_done):
+                def body(carry, i):
+                    c, t, pos = carry
+                    logits, c = model.decode_step_slots(p, c, t, pos, tp_axis)
+                    if temperature <= 0.0:
+                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    else:
+                        def one(row, key, n_done):
+                            k2 = jax.random.fold_in(key, n_done + i)
+                            return sample_token_logits(row, k2, temperature, top_k, top_p)
 
-                    nxt = jax.vmap(one)(logits, base_keys, steps_done)
-                return (c, nxt, jnp.minimum(pos + 1, max_seq - 1)), nxt
+                        nxt = jax.vmap(one)(logits, base_keys, steps_done)
+                    return (c, nxt, jnp.minimum(pos + 1, max_seq - 1)), nxt
 
-            (c, _, _), toks = lax.scan(body, (c, t, pos), jnp.arange(decode_quantum))
-            return toks, c  # toks [k, B]
+                (c, _, _), toks = lax.scan(body, (c, t, pos), jnp.arange(k))
+                return toks, c  # toks [k, B]
+
+            return decode_k
+
+        decode_k = make_decode_k(decode_quantum)
+        decode_turbo = (
+            make_decode_k(decode_quantum * turbo_factor) if turbo_factor else None
+        )
 
         def prefill_fn(p, toks, last):
             return model.prefill(p, toks, tp_axis, last_index=last)
@@ -272,6 +324,10 @@ class ContinuousBatcher:
             # hd] buffers per token (params are NOT donated — they serve
             # every step)
             self._decode = jax.jit(decode_k, donate_argnums=(1,))
+            self._decode_turbo = (
+                jax.jit(decode_turbo, donate_argnums=(1,))
+                if decode_turbo else None
+            )
             # one prefill compile per bucket length (static last_index
             # would recompile per prompt length — keep it traced)
             self._prefill = jax.jit(prefill_fn)
@@ -300,14 +356,20 @@ class ContinuousBatcher:
                 lambda a: jax.device_put(a, head_sh), cache_global
             )
             cache_spec = jax.tree.map(lambda _: P(None, "tp"), cache_global)
-            self._decode = jax.jit(
-                jax.shard_map(
-                    decode_k, mesh=mesh,
-                    in_specs=(pspecs, cache_spec, P(), P(), P(), P()),
-                    out_specs=(P(), cache_spec),
-                    check_vma=False,
-                ),
-                donate_argnums=(1,),
+            def _tp_decode_jit(fn):
+                return jax.jit(
+                    jax.shard_map(
+                        fn, mesh=mesh,
+                        in_specs=(pspecs, cache_spec, P(), P(), P(), P()),
+                        out_specs=(P(), cache_spec),
+                        check_vma=False,
+                    ),
+                    donate_argnums=(1,),
+                )
+
+            self._decode = _tp_decode_jit(decode_k)
+            self._decode_turbo = (
+                _tp_decode_jit(decode_turbo) if decode_turbo else None
             )
             self._prefill = jax.jit(
                 jax.shard_map(
@@ -673,7 +735,35 @@ class ContinuousBatcher:
             [len(self._live[rid].tokens) if rid >= 0 else 0 for rid in self._slot_rid],
             np.int32,
         )
-        toks, self._cache = self._decode(
+        # turbo escalation: in steady-state decode (nothing waiting to
+        # admit) the escalated program amortizes the per-dispatch host round
+        # trip turbo_factor x. Gate on the LARGEST remaining budget: with an
+        # empty queue a slot freed mid-tick would sit idle under plain ticks
+        # too, so turbo wastes nothing a plain schedule would have used — it
+        # just needs one slot that consumes the whole tick to pay for it.
+        # (A mid-tick EOS/budget finish retires exactly as under plain
+        # ticks; the continuing-slot position invariant is budget-derived
+        # and holds for any quantum.)
+        quantum = self.decode_quantum
+        decode = self._decode
+        if (
+            self._decode_turbo is not None
+            and not self._queue
+            and self._pending is None
+        ):
+            turbo_q = self.decode_quantum * self.turbo_factor
+            remaining = max(
+                self._live[int(self._slot_rid[s])].max_new_tokens
+                - len(self._live[int(self._slot_rid[s])].tokens)
+                for s in active
+            )
+            if remaining >= turbo_q:
+                quantum, decode = turbo_q, self._decode_turbo
+        if quantum == self.decode_quantum:
+            self.n_plain_ticks += 1
+        else:
+            self.n_turbo_ticks += 1
+        toks, self._cache = decode(
             self.params,
             self._cache,
             jnp.asarray(self._last_tok),
@@ -685,7 +775,7 @@ class ContinuousBatcher:
         for slot in active:
             req = self._live[int(self._slot_rid[slot])]
             new = emitted.setdefault(req.rid, [])
-            for i in range(self.decode_quantum):
+            for i in range(quantum):
                 tok = int(toks[i, slot])
                 req.tokens.append(tok)
                 new.append(tok)
@@ -694,7 +784,7 @@ class ContinuousBatcher:
                     self._slot_rid[slot] = -1  # freed → next admit reuses it
                     break
             if self._slot_rid[slot] >= 0:  # request continues
-                self._pos[slot] += self.decode_quantum
+                self._pos[slot] += quantum
                 # the jitted scan clamps its cache writes at max_seq-1; a
                 # CONTINUING request must never need that clamp (submit()'s
                 # L + max_new <= max_seq budget guarantees the next write

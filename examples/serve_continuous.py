@@ -36,6 +36,9 @@ class ServeConfig(Config):
     n_slots: int = field(4, help="decode slots (concurrent requests)")
     quantum: int = field(1, help="tokens decoded per scheduler tick (one jitted "
                          "scan; amortizes the per-tick host round trip)")
+    turbo: int = field(0, help="turbo factor: compile a second decode program "
+                       "with quantum*turbo tokens/tick and escalate to it in "
+                       "steady-state decode (0 = off)")
     prefill_chunk: int = field(0, help="chunked-prefill admission: prefill C "
                                "tokens per tick with decode quanta between a "
                                "long prompt's chunks (0 = whole-prompt)")
@@ -73,7 +76,7 @@ def main() -> None:
     srv = ContinuousBatcher(
         model, params, n_slots=cfg.n_slots, temperature=cfg.temperature,
         seed=cfg.seed, prompt_buckets=(16, 32, 64), decode_quantum=cfg.quantum,
-        prefill_chunk=cfg.prefill_chunk,
+        turbo_factor=cfg.turbo, prefill_chunk=cfg.prefill_chunk,
     )
     # warmup pass: compile every bucket's prefill + the decode program so
     # the timed pass measures steady-state serving, not compilation
@@ -81,6 +84,7 @@ def main() -> None:
         srv.submit(p, int(n))
     srv.run()
     rids = [srv.submit(p, int(n)) for p, n in zip(prompts, budgets)]
+    plain0, turbo0 = srv.n_plain_ticks, srv.n_turbo_ticks  # warmup's dispatches
     t0 = time.monotonic()
     steps = 0
     useful_ticks = 0  # decode-lane ticks that produced a wanted token
@@ -89,6 +93,14 @@ def main() -> None:
         steps += 1
     cont_s = time.monotonic() - t0
     srv.collect()
+    n_plain = srv.n_plain_ticks - plain0
+    n_turbo = srv.n_turbo_ticks - turbo0
+    # decode-lane capacity actually dispatched this pass (turbo ticks carry
+    # turbo x the base quantum). useful_ticks counts every emitted token
+    # including each request's prefill-sampled FIRST token, which consumes
+    # no decode lane — drop those so utilization stays <= 100%
+    useful_ticks -= cfg.requests
+    lane_capacity = (n_plain + n_turbo * max(cfg.turbo, 1)) * cfg.quantum * cfg.n_slots
 
     # ---- static-batch baseline: groups of n_slots, everyone waits for the
     # group's longest budget (what a naive batched `generate` loop does) -----
@@ -120,11 +132,12 @@ def main() -> None:
         static_useful += sum(int(budgets[g]) - 1 for g in group)
         static_ticks += (n_max - 1) * cfg.n_slots
 
-    util = useful_ticks / max(steps * cfg.n_slots * cfg.quantum, 1)
+    util = useful_ticks / max(lane_capacity, 1)
     static_util = static_useful / max(static_ticks, 1)
     log.info(
-        "continuous: %.2fs (%d scheduler steps, lane utilization %.0f%%)",
-        cont_s, steps, 100 * util,
+        "continuous: %.2fs (%d scheduler steps, lane utilization %.0f%%, "
+        "%d plain / %d turbo decode dispatches)",
+        cont_s, steps, 100 * util, n_plain, n_turbo,
     )
     log.info(
         "static    : %.2fs (lane utilization %.0f%% — idle lanes wait for the "

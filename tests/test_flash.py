@@ -181,3 +181,17 @@ def test_gpt2_flash_attn_impl_matches_default():
     base = model.apply_spmd(params, tokens, attn_impl="xla")
     flash = model.apply_spmd(params, tokens, attn_impl="flash")
     np.testing.assert_allclose(np.asarray(flash), np.asarray(base), rtol=1e-4, atol=1e-4)
+
+
+def test_default_blocks_adapt_to_kv_length():
+    """The hardware-swept auto defaults: 512x512 below 4096 kv, 512x1024 at
+    or above (scripts/flash_block_sweep.py measured 1.4x on a v5e at 8k);
+    explicit blocks always win."""
+    from dsml_tpu.ops.flash import _default_blocks
+
+    assert _default_blocks(1024, None, None) == (512, 512)
+    assert _default_blocks(2048, None, None) == (512, 512)
+    assert _default_blocks(4096, None, None) == (512, 1024)
+    assert _default_blocks(8192, None, None) == (512, 1024)
+    assert _default_blocks(8192, 256, 512) == (256, 512)
+    assert _default_blocks(8192, None, 2048) == (512, 2048)

@@ -642,3 +642,85 @@ def test_budget_one_requests_drain_through_one_slot():
     rid2 = srv.submit(prompts[0], 2)
     out2 = srv.run()
     assert set(out2) == {rid2}
+
+
+def test_turbo_factor_tokens_identical_and_engages():
+    """turbo_factor is pure dispatch amortization: greedy AND sampled
+    tokens equal the plain batcher's (and therefore generate's), and the
+    escalated program actually engages once the queue drains and every
+    active request holds the turbo budget (counter-pinned)."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(3)
+    prompts = _prompts(cfg, [5, 9, 7], seed=3)
+    # the middle request retires first; the queued third then admits with a
+    # large budget, so once the queue drains every active request still
+    # holds >= the turbo quantum (6) and the escalation engages
+    budgets = [40, 12, 38]
+
+    def serve(turbo, temperature=0.0):
+        srv = ContinuousBatcher(model, params, n_slots=2,
+                                temperature=temperature, prompt_buckets=(16,),
+                                decode_quantum=2, turbo_factor=turbo)
+        rids = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+        out = srv.run()
+        return [out[r] for r in rids], srv
+
+    base, srv0 = serve(0)
+    turbo, srv1 = serve(3)
+    assert base == turbo
+    assert srv0.n_turbo_ticks == 0 and srv1.n_turbo_ticks > 0
+    # and the turbo run used strictly fewer decode dispatches
+    assert (srv1.n_turbo_ticks + srv1.n_plain_ticks) < srv0.n_plain_ticks
+
+    sb, _ = serve(0, temperature=0.9)
+    st, srv2 = serve(3, temperature=0.9)
+    assert sb == st and srv2.n_turbo_ticks > 0
+
+
+def test_turbo_respects_eos_and_admissions():
+    """An EOS mid-turbo retires the request exactly where the plain
+    batcher would (the sampled stream makes the tokens non-degenerate —
+    tiny-model greedy collapses to one repeated token); while a request
+    waits in the queue the turbo program never runs (admission cadence
+    keeps the base quantum)."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(2)
+    prompt = _prompts(cfg, [6], seed=2)[0]
+
+    def serve(turbo, eos=None):
+        srv = ContinuousBatcher(model, params, n_slots=1, eos_id=eos,
+                                temperature=0.8, seed=7, prompt_buckets=(8,),
+                                decode_quantum=1, turbo_factor=turbo)
+        a = srv.submit(prompt, 12)
+        b = srv.submit(prompt, 12)  # queued behind the single slot
+        out = srv.run()
+        return out[a], out[b], srv
+
+    ra, rb, _ = serve(0)
+    # rid0 decodes under PLAIN ticks (rid1 waits in the queue, which gates
+    # turbo off); rid1 runs alone afterwards, all-turbo. Draw the eos from
+    # rid1's OWN stream at an index inside its second turbo quantum
+    # (emissions: prefill tok 0, turbo ticks decode 1-4, 5-8, ...) so the
+    # truncated-tail discard path of a turbo tick is what retires it.
+    eos = rb[6]
+    assert eos not in rb[:6]  # really retires at index 6, mid-quantum
+    pa, pb, s0 = serve(0, eos)
+    ta, tb, s1 = serve(4, eos)
+    assert (pa, pb) == (ta, tb)
+    assert len(pb) == 7 and pb[-1] == eos  # truncated at the mid-turbo eos
+    assert s0.n_turbo_ticks == 0 and s1.n_turbo_ticks > 0
+
+
+def test_turbo_factor_validation():
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(0)
+    with pytest.raises(ValueError, match="turbo_factor"):
+        ContinuousBatcher(model, params, turbo_factor=1)
+    with pytest.raises(ValueError, match="speculative"):
+        ContinuousBatcher(model, params, turbo_factor=2, speculative_window=4)
+    with pytest.raises(ValueError, match="max_seq"):
+        ContinuousBatcher(model, params, decode_quantum=cfg.max_seq,
+                          turbo_factor=2)
