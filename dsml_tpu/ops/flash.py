@@ -85,16 +85,20 @@ def _pick_block(seq: int, preferred: int) -> int | None:
     return None
 
 
-def _default_blocks(s_kv: int, block_q: int | None, block_k: int | None) -> tuple[int, int]:
+def _default_blocks(
+    s_q: int, s_kv: int, block_q: int | None, block_k: int | None
+) -> tuple[int, int]:
     """Swept-on-hardware block defaults (scripts/flash_block_sweep.py on a
-    v5e, k_extra=16 differenced timing): at kv length >= 4096 a 1024-wide
-    kv block runs the fwd+bwd pair ~1.4x faster than 512x512 (42.7 vs 31.2
-    TFLOPs at seq 8192 — fewer grid revisits of the dq/dkv accumulators);
-    below that the 512x512 tiling measured best-or-equal wherever the
+    v5e, k_extra=16 differenced timing): at sequence lengths >= 4096 the
+    1024x1024 tiling runs the fwd+bwd pair ~1.4x faster than 512x512
+    (43.7 vs 31.2 TFLOPs at seq 8192 — fewer grid revisits of the dq/dkv
+    accumulators); anything wider than 1024 fails TPU compilation (VMEM).
+    Below 4096 the 512x512 tiling measured best-or-equal wherever the
     differenced signal rose above tunnel jitter. Callers can still pin
-    blocks explicitly (the ring path does, per-shard)."""
+    blocks explicitly (the ring path does, per-shard); lengths the
+    preferred block doesn't divide degrade through _pick_block's ladder."""
     if block_q is None:
-        block_q = 512
+        block_q = 1024 if s_q >= 4096 else 512
     if block_k is None:
         block_k = 1024 if s_kv >= 4096 else 512
     return block_q, block_k
@@ -424,7 +428,7 @@ def flash_attention_lse(
     """
     b, h, s_q, d = q.shape
     s_kv = k.shape[2]
-    block_q, block_k = _default_blocks(s_kv, block_q, block_k)
+    block_q, block_k = _default_blocks(s_q, s_kv, block_q, block_k)
     bq = _pick_block(s_q, block_q)
     bk = _pick_block(s_kv, block_k)
     if bq is None or bk is None:
@@ -454,7 +458,7 @@ def flash_attention(
     """
     if q.ndim != 4:
         raise ValueError(f"expected [batch, heads, seq, head_dim], got {q.shape}")
-    block_q, block_k = _default_blocks(k.shape[2], block_q, block_k)
+    block_q, block_k = _default_blocks(q.shape[2], k.shape[2], block_q, block_k)
     if _pick_block(q.shape[2], block_q) is None or _pick_block(k.shape[2], block_k) is None:
         from dsml_tpu.ops.attention import attention
 
@@ -494,7 +498,7 @@ def ring_flash_attention(
     seq_block = q.shape[-2]
     # per-SHARD kv length decides the block defaults (each hop's flash call
     # sees one shard of K/V)
-    block_q, block_k = _default_blocks(seq_block, block_q, block_k)
+    block_q, block_k = _default_blocks(seq_block, seq_block, block_q, block_k)
     if _pick_block(seq_block, block_q) is None or _pick_block(seq_block, block_k) is None:
         from dsml_tpu.ops.attention import ring_attention
 

@@ -1,0 +1,213 @@
+"""Byte-level BPE tokenizer — trained, saved, and loaded with zero network.
+
+The reference has no text pipeline at all (its only model consumes MNIST
+pixels, ``DSML/client/client.go:270-350``); this framework's LM families
+need one, and pretrained tokenizer assets cannot be downloaded in the
+deployment environment. So the tokenizer is its own component: classic
+byte-level BPE (the GPT-2 algorithm — Sennrich et al. merges over a byte
+base vocabulary) trained on any corpus, serialized to a single JSON file.
+
+Design points:
+
+- **Byte base vocabulary** (ids 0-255): any UTF-8 input round-trips exactly
+  — no unknown-token path, no normalization of any kind (NFC/NFD inputs
+  round-trip as given). ``decode(encode(s)) == s`` for arbitrary ``s``
+  (pinned in tests, including emoji/CJK and decomposed accents).
+- **Pre-tokenization** splits text into word-ish pieces (leading-space
+  convention like GPT-2: ``" the"`` is one piece, so merges never cross
+  word boundaries and frequent words become single tokens). The piece
+  pattern covers every character class, which is what makes the round-trip
+  exact by construction.
+- **Training** is the standard weighted-pair-count loop over the UNIQUE
+  pieces (not the raw stream), deterministic: ties break on the
+  lexicographically smallest pair so the same corpus always yields the
+  same merges.
+- **Encoding** applies merges by rank with a per-piece cache (the hot path
+  is a dict lookup per word, not a merge loop).
+
+Usage::
+
+    tok = BPETokenizer.train(corpus_text, vocab_size=2048)
+    ids = tok.encode("Attention is all you need.")
+    tok.save("data/bpe_2048.json");  tok2 = BPETokenizer.load(...)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from collections import Counter
+
+import numpy as np
+
+__all__ = ["BPETokenizer"]
+
+# every char lands in exactly one alternative: space-prefixed letter runs,
+# space-prefixed digit runs, space-prefixed symbol runs, then bare
+# whitespace runs (a greedy \s+ keeps the final space before a word for the
+# " word" alternatives only when it is the single separating space — longer
+# gaps stay whitespace tokens)
+_PIECE_RE = re.compile(r" ?[^\W\d_]+| ?\d+| ?[^\w\s]+|\s+", re.UNICODE)
+
+
+def _pieces(text: str) -> list[str]:
+    return _PIECE_RE.findall(text)
+
+
+class BPETokenizer:
+    """A trained byte-level BPE vocabulary: ``merges`` is the ordered list
+    of (left_id, right_id) pairs; merge i produces token id ``256 + i``.
+    ``eos_id``/``bos_id`` (optional) are appended after the merge tokens."""
+
+    def __init__(self, merges: list[tuple[int, int]], specials: tuple[str, ...] = ("<|eos|>",)):
+        self.merges = [tuple(m) for m in merges]
+        self.specials = tuple(specials)
+        self._ranks = {pair: i for i, pair in enumerate(self.merges)}
+        # token id -> bytes (specials decode to their literal text)
+        self._bytes: list[bytes] = [bytes([b]) for b in range(256)]
+        for a, b in self.merges:
+            if a >= len(self._bytes) or b >= len(self._bytes):
+                raise ValueError(f"merge ({a}, {b}) references an undefined token")
+            self._bytes.append(self._bytes[a] + self._bytes[b])
+        self._special_ids = {
+            s: 256 + len(self.merges) + i for i, s in enumerate(self.specials)
+        }
+        self._cache: dict[bytes, list[int]] = {}
+
+    # ---- vocabulary ----------------------------------------------------------
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(self.merges) + len(self.specials)
+
+    @property
+    def eos_id(self) -> int | None:
+        return self._special_ids.get("<|eos|>")
+
+    def special_id(self, token: str) -> int:
+        return self._special_ids[token]
+
+    def token_bytes(self, tid: int) -> bytes:
+        if tid < 256 + len(self.merges):
+            return self._bytes[tid]
+        return self.specials[tid - 256 - len(self.merges)].encode("utf-8")
+
+    # ---- train ---------------------------------------------------------------
+
+    @classmethod
+    def train(
+        cls,
+        text: str,
+        vocab_size: int = 2048,
+        specials: tuple[str, ...] = ("<|eos|>",),
+        min_pair_freq: int = 2,
+    ) -> "BPETokenizer":
+        """Learn ``vocab_size - 256 - len(specials)`` merges from ``text``.
+        Deterministic for a fixed corpus (ties break on the smaller pair).
+        Stops early when no pair reaches ``min_pair_freq`` — a tiny corpus
+        yields a smaller vocab rather than degenerate merges."""
+        n_merges = vocab_size - 256 - len(specials)
+        if n_merges < 0:
+            raise ValueError(
+                f"vocab_size={vocab_size} cannot hold the 256 byte tokens "
+                f"plus {len(specials)} specials"
+            )
+        piece_freq = Counter(_pieces(text))
+        # unique pieces as mutable symbol sequences + their frequencies
+        words: list[list[int]] = []
+        freqs: list[int] = []
+        for piece, f in piece_freq.items():
+            words.append(list(piece.encode("utf-8")))
+            freqs.append(f)
+
+        merges: list[tuple[int, int]] = []
+        for _ in range(n_merges):
+            counts: Counter = Counter()
+            for w, f in zip(words, freqs):
+                for a, b in zip(w, w[1:]):
+                    counts[(a, b)] += f
+            if not counts:
+                break
+            # deterministic argmax: highest count, then smallest pair
+            pair, best = min(
+                counts.items(), key=lambda kv: (-kv[1], kv[0])
+            )
+            if best < min_pair_freq:
+                break
+            new_id = 256 + len(merges)
+            merges.append(pair)
+            a, b = pair
+            for w in words:
+                i, out = 0, []
+                while i < len(w):
+                    if i + 1 < len(w) and w[i] == a and w[i + 1] == b:
+                        out.append(new_id)
+                        i += 2
+                    else:
+                        out.append(w[i])
+                        i += 1
+                w[:] = out
+        return cls(merges, specials)
+
+    # ---- encode / decode -----------------------------------------------------
+
+    def _bpe(self, piece: bytes) -> list[int]:
+        cached = self._cache.get(piece)
+        if cached is not None:
+            return cached
+        w = list(piece)
+        while len(w) > 1:
+            # the lowest-rank (earliest-learned) adjacent pair merges first —
+            # the same order training created them
+            best_rank, best_i = None, -1
+            for i, pair in enumerate(zip(w, w[1:])):
+                r = self._ranks.get(pair)
+                if r is not None and (best_rank is None or r < best_rank):
+                    best_rank, best_i = r, i
+            if best_rank is None:
+                break
+            w[best_i : best_i + 2] = [256 + best_rank]
+        if len(self._cache) < 1 << 20:  # bound the cache on adversarial input
+            self._cache[piece] = w
+        return w
+
+    def encode(self, text: str) -> list[int]:
+        ids: list[int] = []
+        for piece in _pieces(text):
+            ids.extend(self._bpe(piece.encode("utf-8")))
+        return ids
+
+    def encode_array(self, text: str) -> np.ndarray:
+        return np.asarray(self.encode(text), np.int32)
+
+    def decode(self, ids) -> str:
+        out = bytearray()
+        n_plain = 256 + len(self.merges)
+        for tid in np.asarray(ids).reshape(-1).tolist():
+            if tid < 0 or tid >= self.vocab_size:
+                raise ValueError(f"token id {tid} outside vocab {self.vocab_size}")
+            out += self.token_bytes(int(tid)) if tid < n_plain else self.specials[
+                tid - n_plain
+            ].encode("utf-8")
+        return out.decode("utf-8", errors="replace")
+
+    # ---- persistence ---------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "format": "dsml_bpe_v1",
+                    "merges": [list(m) for m in self.merges],
+                    "specials": list(self.specials),
+                },
+                f,
+            )
+
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        with open(path) as f:
+            blob = json.load(f)
+        if blob.get("format") != "dsml_bpe_v1":
+            raise ValueError(f"{path!r} is not a dsml_bpe_v1 tokenizer file")
+        return cls([tuple(m) for m in blob["merges"]], tuple(blob["specials"]))

@@ -44,6 +44,12 @@ class GPT2TrainConfig(Config):
         "", help="UTF-8 text file to train on; 'prose' = real on-disk English "
         "corpus (utils.data.load_text_corpus); '' = generated stories"
     )
+    tokenizer: str = field(
+        "", help="'' = byte-level (vocab 256); 'bpe' = train (or load the "
+        "cached) byte-level BPE on the corpus and train over its ids "
+        "(utils.tokenizer.BPETokenizer)"
+    )
+    bpe_vocab: int = field(2048, help="BPE vocab size (with --tokenizer bpe)")
     steps: int = field(50, help="optimizer steps")
     batch_size: int = field(8, help="GLOBAL batch size (rows per optimizer step)")
     seq_len: int = field(0, help="sequence length (0 = model max)")
@@ -167,7 +173,48 @@ def main(argv=None):
         log.info("no --data file; generated %d bytes of story corpus", len(corpus))
     from dsml_tpu.utils.data import carve_lm_eval_split, lm_window_batches, prefetch_batches
 
-    tokens = np.frombuffer(corpus, np.uint8).astype(np.int32) % model_cfg.vocab_size
+    if cfg.tokenizer == "bpe":
+        # train-or-load a BPE on THIS corpus (cache keyed on corpus digest +
+        # vocab, under data/ — retraining is pure waste), then rebuild the
+        # model at the tokenizer's vocab. Tokens/byte is logged: the
+        # compression is the point (more text per sequence position).
+        import hashlib
+
+        from dsml_tpu.utils.tokenizer import BPETokenizer
+
+        text = corpus.decode("utf-8", errors="replace")
+        digest = hashlib.sha1(corpus).hexdigest()[:8]
+        cache = os.path.join("data", f"bpe_v{cfg.bpe_vocab}_{digest}.json")
+        if os.path.exists(cache):
+            tok = BPETokenizer.load(cache)
+            log.info("loaded cached BPE %s (vocab %d)", cache, tok.vocab_size)
+        else:
+            t0 = time.monotonic()
+            tok = BPETokenizer.train(text, vocab_size=cfg.bpe_vocab)
+            os.makedirs("data", exist_ok=True)
+            tok.save(cache)
+            log.info(
+                "trained BPE vocab %d in %.1fs → cached at %s",
+                tok.vocab_size, time.monotonic() - t0, cache,
+            )
+        tokens = tok.encode_array(text)
+        log.info(
+            "BPE tokens: %d (%.2f bytes/token vs 1.0 byte-level)",
+            len(tokens), len(corpus) / max(len(tokens), 1),
+        )
+        # the embedding is vocab-sharded P('tp', ...) under tensor
+        # parallelism, and early-stopped training can return any vocab —
+        # pad to the next tp multiple (the dead rows are never indexed;
+        # rounding up also keeps the unembed matmul MXU-tileable)
+        vocab = -(-tok.vocab_size // max(cfg.tp, 1)) * max(cfg.tp, 1)
+        if vocab != tok.vocab_size:
+            log.info("padding vocab %d → %d (tp=%d)", tok.vocab_size, vocab, cfg.tp)
+        model_cfg = dataclasses.replace(model_cfg, vocab_size=vocab)
+        model = type(model)(model_cfg)
+    elif cfg.tokenizer:
+        raise SystemExit(f"unknown --tokenizer {cfg.tokenizer!r} (use '' or 'bpe')")
+    else:
+        tokens = np.frombuffer(corpus, np.uint8).astype(np.int32) % model_cfg.vocab_size
     eval_tokens = None
     if cfg.eval_every:
         tokens, eval_tokens = carve_lm_eval_split(tokens, seq, cfg.batch_size)

@@ -183,15 +183,17 @@ def test_gpt2_flash_attn_impl_matches_default():
     np.testing.assert_allclose(np.asarray(flash), np.asarray(base), rtol=1e-4, atol=1e-4)
 
 
-def test_default_blocks_adapt_to_kv_length():
-    """The hardware-swept auto defaults: 512x512 below 4096 kv, 512x1024 at
-    or above (scripts/flash_block_sweep.py measured 1.4x on a v5e at 8k);
-    explicit blocks always win."""
+def test_default_blocks_adapt_to_sequence_lengths():
+    """The hardware-swept auto defaults adapt q and kv blocks to their own
+    lengths: 512 below 4096, 1024 at or above (scripts/flash_block_sweep.py
+    measured 1.4x on a v5e at 8k); explicit blocks always win."""
     from dsml_tpu.ops.flash import _default_blocks
 
-    assert _default_blocks(1024, None, None) == (512, 512)
-    assert _default_blocks(2048, None, None) == (512, 512)
-    assert _default_blocks(4096, None, None) == (512, 1024)
-    assert _default_blocks(8192, None, None) == (512, 1024)
-    assert _default_blocks(8192, 256, 512) == (256, 512)
-    assert _default_blocks(8192, None, 2048) == (512, 2048)
+    assert _default_blocks(1024, 1024, None, None) == (512, 512)
+    assert _default_blocks(2048, 2048, None, None) == (512, 512)
+    assert _default_blocks(4096, 4096, None, None) == (1024, 1024)
+    assert _default_blocks(8192, 8192, None, None) == (1024, 1024)
+    # decode-shaped call: short q against a long cache widens only kv
+    assert _default_blocks(512, 8192, None, None) == (512, 1024)
+    assert _default_blocks(8192, 8192, 256, 512) == (256, 512)
+    assert _default_blocks(8192, 8192, None, 2048) == (1024, 2048)

@@ -1,0 +1,97 @@
+"""Trained byte-level BPE tokenizer: lossless round-trip, deterministic
+training, merge semantics, persistence. The tokenizer is pure host-side
+Python (no device) — these tests pin the component the LM data pipeline
+offers above raw bytes."""
+
+import numpy as np
+import pytest
+
+from dsml_tpu.utils.tokenizer import BPETokenizer
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "the quicker the fox, the lazier the dog! "
+    "pack my box with five dozen liquor jugs. "
+) * 20
+
+
+def test_roundtrip_exact_ascii_and_unicode():
+    tok = BPETokenizer.train(CORPUS, vocab_size=400)
+    for s in [
+        "the quick brown fox",
+        "unseen words survive: zyzzyva!",
+        "unicode: café — 你好 \U0001f680",
+        "decomposed: cafe\u0301 vs caf\u00e9",  # NFD input must round-trip AS GIVEN
+        "  leading and   irregular   spaces\n\ttabs\n",
+        "",
+    ]:
+        assert tok.decode(tok.encode(s)) == s
+
+
+def test_compression_beats_bytes_on_training_distribution():
+    tok = BPETokenizer.train(CORPUS, vocab_size=400)
+    ids = tok.encode(CORPUS)
+    n_bytes = len(CORPUS.encode())
+    # frequent words ("the", "fox", ...) became multi-byte tokens
+    assert len(ids) < 0.6 * n_bytes
+    # every id within the declared vocab
+    assert max(ids) < tok.vocab_size and min(ids) >= 0
+
+
+def test_training_is_deterministic():
+    a = BPETokenizer.train(CORPUS, vocab_size=350)
+    b = BPETokenizer.train(CORPUS, vocab_size=350)
+    assert a.merges == b.merges
+    assert a.encode(CORPUS[:200]) == b.encode(CORPUS[:200])
+
+
+def test_merges_apply_in_rank_order():
+    # train on pure repetition: the first merges must capture it
+    tok = BPETokenizer.train("ababababab " * 50, vocab_size=270)
+    ids = tok.encode("ababab")
+    # "ababab" compresses well below its 6 bytes
+    assert len(ids) <= 3
+    assert tok.decode(ids) == "ababab"
+
+
+def test_tiny_corpus_stops_early_not_degenerate():
+    tok = BPETokenizer.train("ab", vocab_size=2048)
+    # nothing repeats, so (almost) no merges are learnable; vocab collapses
+    # to roughly the byte base instead of inventing junk
+    assert tok.vocab_size < 300
+    assert tok.decode(tok.encode("ab")) == "ab"
+
+
+def test_save_load_roundtrip(tmp_path):
+    tok = BPETokenizer.train(CORPUS, vocab_size=400)
+    p = str(tmp_path / "bpe.json")
+    tok.save(p)
+    tok2 = BPETokenizer.load(p)
+    assert tok2.merges == tok.merges
+    s = "the lazy dog packs liquor"
+    assert tok2.encode(s) == tok.encode(s)
+    with pytest.raises(ValueError, match="dsml_bpe_v1"):
+        bad = str(tmp_path / "bad.json")
+        open(bad, "w").write("{}")
+        BPETokenizer.load(bad)
+
+
+def test_specials_and_eos():
+    tok = BPETokenizer.train(CORPUS, vocab_size=400, specials=("<|eos|>", "<|pad|>"))
+    assert tok.eos_id == tok.vocab_size - 2
+    assert tok.special_id("<|pad|>") == tok.vocab_size - 1
+    ids = tok.encode("the dog") + [tok.eos_id]
+    assert tok.decode(ids).endswith("<|eos|>")
+
+
+def test_encode_array_dtype():
+    tok = BPETokenizer.train(CORPUS, vocab_size=300)
+    arr = tok.encode_array("the fox")
+    assert arr.dtype == np.int32 and arr.ndim == 1
+
+
+def test_vocab_size_validation():
+    with pytest.raises(ValueError, match="vocab_size"):
+        BPETokenizer.train(CORPUS, vocab_size=200)
+    with pytest.raises(ValueError, match="undefined token"):
+        BPETokenizer(merges=[(300, 301)])
