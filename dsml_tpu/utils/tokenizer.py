@@ -40,14 +40,33 @@ from collections import Counter
 
 import numpy as np
 
-__all__ = ["BPETokenizer"]
+__all__ = ["BPETokenizer", "padded_vocab"]
+
+
+def padded_vocab(n: int, tp: int = 1) -> int:
+    """Model vocab for a trained tokenizer of ``n`` ids: rounded up to a
+    multiple of lcm(8, tp). The fixed 8 makes the padding REPRODUCIBLE
+    across runs that shard differently (a checkpoint trained at tp=4 must
+    restore under tp=1 serving — both sides compute the same number for
+    any tp <= 8, the realistic range here), keeps the embedding divisible
+    for vocab-sharding, and rounds the unembed matmul toward MXU tiles.
+    The padded rows are never produced by encode() and never sampled from
+    a trained model (their logits only see gradient through softmax mass).
+    tp > 8 still pads correctly for training but needs the SAME tp at
+    serving — padded_vocab is deliberately tp-stable only up to 8."""
+    m = 8
+    while m % tp:  # lcm(8, tp) for the tp > 8 case
+        m += 8
+    return -(-n // m) * m
 
 # every char lands in exactly one alternative: space-prefixed letter runs,
-# space-prefixed digit runs, space-prefixed symbol runs, then bare
-# whitespace runs (a greedy \s+ keeps the final space before a word for the
-# " word" alternatives only when it is the single separating space — longer
-# gaps stay whitespace tokens)
-_PIECE_RE = re.compile(r" ?[^\W\d_]+| ?\d+| ?[^\w\s]+|\s+", re.UNICODE)
+# space-prefixed digit runs, space-prefixed symbol runs (underscore counts
+# as a symbol: \w contains it, so [^\w\s] alone would DROP it and break the
+# round-trip on snake_case text), then bare whitespace runs (a greedy \s+
+# keeps the final space before a word for the " word" alternatives only
+# when it is the single separating space — longer gaps stay whitespace
+# tokens)
+_PIECE_RE = re.compile(r" ?[^\W\d_]+| ?\d+| ?(?:[^\w\s]|_)+|\s+", re.UNICODE)
 
 
 def _pieces(text: str) -> list[str]:
@@ -120,24 +139,41 @@ class BPETokenizer:
             words.append(list(piece.encode("utf-8")))
             freqs.append(f)
 
+        # incremental pair bookkeeping: recounting every pair after every
+        # merge is O(merges x corpus) and dominates training time; instead
+        # keep global counts plus an occurs-in index and touch only the
+        # words that actually contain the merged pair (the standard fast
+        # BPE trainer shape — ~10x on the repo prose corpus)
+        counts: Counter = Counter()
+        where: dict[tuple[int, int], set[int]] = {}
+        for wi, (w, f) in enumerate(zip(words, freqs)):
+            for pair in zip(w, w[1:]):
+                counts[pair] += f
+                where.setdefault(pair, set()).add(wi)
+
         merges: list[tuple[int, int]] = []
         for _ in range(n_merges):
-            counts: Counter = Counter()
-            for w, f in zip(words, freqs):
-                for a, b in zip(w, w[1:]):
-                    counts[(a, b)] += f
             if not counts:
                 break
             # deterministic argmax: highest count, then smallest pair
-            pair, best = min(
-                counts.items(), key=lambda kv: (-kv[1], kv[0])
-            )
+            pair, best = min(counts.items(), key=lambda kv: (-kv[1], kv[0]))
             if best < min_pair_freq:
                 break
             new_id = 256 + len(merges)
             merges.append(pair)
             a, b = pair
-            for w in words:
+            for wi in list(where.get(pair, ())):
+                w, f = words[wi], freqs[wi]
+                # retract this word's old pairs, rewrite, re-add new pairs
+                for p in zip(w, w[1:]):
+                    counts[p] -= f
+                    if counts[p] <= 0:
+                        del counts[p]
+                    s = where.get(p)
+                    if s is not None:
+                        s.discard(wi)
+                        if not s:
+                            del where[p]
                 i, out = 0, []
                 while i < len(w):
                     if i + 1 < len(w) and w[i] == a and w[i + 1] == b:
@@ -147,6 +183,9 @@ class BPETokenizer:
                         out.append(w[i])
                         i += 1
                 w[:] = out
+                for p in zip(w, w[1:]):
+                    counts[p] += f
+                    where.setdefault(p, set()).add(wi)
         return cls(merges, specials)
 
     # ---- encode / decode -----------------------------------------------------

@@ -4,6 +4,8 @@ of examples/train_gpt2.py.
 Loads the latest checkpoint from ``--checkpoint_dir`` (as written by
 ``train_gpt2.py --checkpoint_dir ...``) or falls back to fresh weights, runs
 the compiled prefill + KV-cache decode loop, and prints the continuations.
+Prompts tokenize as raw bytes by default; ``--bpe`` switches both encode
+and decode to a trained ``utils.tokenizer.BPETokenizer``.
 The reference had no inference path at all (SURVEY.md: its only "model" ran
 forward on the client CPU during training).
 
@@ -31,7 +33,7 @@ class GenerateConfig(Config):
     model: str = field("tiny", help="preset — must match the trained model (gpt2: tiny|small|medium|large|xl; llama: tiny|tinyllama_1b|llama2_7b|llama3_8b)")
     family: str = field("gpt2", help="model family: gpt2 | llama")
     checkpoint_dir: str = field("", help="Orbax dir from train_gpt2 ('' = fresh weights)")
-    prompt: str = field("the cat ", help="prompt text (byte-tokenized)")
+    prompt: str = field("the cat ", help="prompt text (byte-tokenized, or BPE-encoded with --bpe)")
     n_samples: int = field(2, help="continuations to sample")
     max_new_tokens: int = field(64, help="tokens (bytes) to generate per sample")
     temperature: float = field(0.8, help="0 = greedy")
@@ -41,6 +43,9 @@ class GenerateConfig(Config):
     eos: int = field(-1, help="stop token id (-1 = none); rows pad with it after stopping")
     speculative: int = field(0, help="greedy prompt-lookup speculative decode with this verify window (>=2; forces temperature 0, single-device)")
     tp: int = field(1, help="tensor-parallel serving: shard heads/vocab/KV-cache over this many devices (generate_spmd)")
+    bpe: str = field("", help="path to a trained BPE json (utils.tokenizer; the "
+                     "data/bpe_v*.json cache train_gpt2 --tokenizer bpe wrote): "
+                     "prompt encodes and output decodes through it")
 
 
 def main(argv=None):
@@ -56,8 +61,22 @@ def main(argv=None):
     log = get_logger("generate")
     from dsml_tpu.models import model_by_family
 
+    tok = None
+    vocab = 256  # tiny = byte tokens
+    if cfg.bpe:
+        from dsml_tpu.utils.tokenizer import BPETokenizer
+
+        from dsml_tpu.utils.tokenizer import padded_vocab
+
+        tok = BPETokenizer.load(cfg.bpe)
+        # the SAME tp-stable padding rule train_gpt2 used, so the
+        # checkpoint's embedding/head shapes match for any tp <= 8 on
+        # either side
+        vocab = padded_vocab(tok.vocab_size, cfg.tp)
+        log.info("BPE tokenizer %s: vocab %d (model vocab %d)",
+                 cfg.bpe, tok.vocab_size, vocab)
     try:
-        model, model_cfg = model_by_family(cfg.family, cfg.model, vocab_size=256)  # tiny = byte tokens
+        model, model_cfg = model_by_family(cfg.family, cfg.model, vocab_size=vocab)
     except ValueError as e:
         raise SystemExit(str(e))
     params = model.init(0)
@@ -71,9 +90,14 @@ def main(argv=None):
 
     if not cfg.prompt:
         raise SystemExit("--prompt must be non-empty")
-    prompt_bytes = np.frombuffer(cfg.prompt.encode(), np.uint8).astype(np.int32)
-    prompt_bytes = prompt_bytes % model_cfg.vocab_size
-    prompt = jnp.asarray(np.tile(prompt_bytes, (cfg.n_samples, 1)))
+    if tok is not None:
+        prompt_ids = tok.encode_array(cfg.prompt)
+        if len(prompt_ids) == 0:
+            raise SystemExit("--prompt encoded to zero BPE tokens")
+    else:
+        prompt_ids = np.frombuffer(cfg.prompt.encode(), np.uint8).astype(np.int32)
+        prompt_ids = prompt_ids % model_cfg.vocab_size
+    prompt = jnp.asarray(np.tile(prompt_ids, (cfg.n_samples, 1)))
 
     sample_kwargs = dict(
         max_new_tokens=cfg.max_new_tokens,
@@ -117,7 +141,12 @@ def main(argv=None):
         out = model.generate(params, prompt, **sample_kwargs)
     texts = []
     for row in np.asarray(out):
-        text = bytes(int(t) % 256 for t in row).decode("utf-8", errors="replace")
+        if tok is not None:
+            # padded vocab rows (>= tok.vocab_size) can only appear from a
+            # fresh-weights run; map them to byte 0 rather than crash
+            text = tok.decode([int(t) if t < tok.vocab_size else 0 for t in row])
+        else:
+            text = bytes(int(t) % 256 for t in row).decode("utf-8", errors="replace")
         texts.append(text)
         print(f"{cfg.prompt!r} -> {text!r}")
     return texts

@@ -204,9 +204,12 @@ def main(argv=None):
         )
         # the embedding is vocab-sharded P('tp', ...) under tensor
         # parallelism, and early-stopped training can return any vocab —
-        # pad to the next tp multiple (the dead rows are never indexed;
-        # rounding up also keeps the unembed matmul MXU-tileable)
-        vocab = -(-tok.vocab_size // max(cfg.tp, 1)) * max(cfg.tp, 1)
+        # padded_vocab rounds to an lcm(8, tp) multiple so a checkpoint
+        # trained here restores under any serving tp <= 8 (the dead rows
+        # are never indexed; rounding also keeps the unembed MXU-tileable)
+        from dsml_tpu.utils.tokenizer import padded_vocab
+
+        vocab = padded_vocab(tok.vocab_size, cfg.tp)
         if vocab != tok.vocab_size:
             log.info("padding vocab %d → %d (tp=%d)", tok.vocab_size, vocab, cfg.tp)
         model_cfg = dataclasses.replace(model_cfg, vocab_size=vocab)

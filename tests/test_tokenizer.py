@@ -22,6 +22,7 @@ def test_roundtrip_exact_ascii_and_unicode():
         "unseen words survive: zyzzyva!",
         "unicode: café — 你好 \U0001f680",
         "decomposed: cafe\u0301 vs caf\u00e9",  # NFD input must round-trip AS GIVEN
+        "snake_case_names and __dunder__ and _ alone",  # _ is \w but not a letter
         "  leading and   irregular   spaces\n\ttabs\n",
         "",
     ]:
@@ -95,3 +96,15 @@ def test_vocab_size_validation():
         BPETokenizer.train(CORPUS, vocab_size=200)
     with pytest.raises(ValueError, match="undefined token"):
         BPETokenizer(merges=[(300, 301)])
+
+
+def test_padded_vocab_is_tp_stable():
+    from dsml_tpu.utils.tokenizer import padded_vocab
+
+    # identical for every tp <= 8 — the checkpoint-portability contract
+    for n in [257, 731, 1024, 2050]:
+        base = padded_vocab(n, 1)
+        assert base % 8 == 0 and base >= n
+        for tp in (1, 2, 4, 8):
+            assert padded_vocab(n, tp) == base
+    assert padded_vocab(2050, 16) == 2064  # tp > 8: lcm respected
