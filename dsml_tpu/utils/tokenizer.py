@@ -48,12 +48,14 @@ def padded_vocab(n: int, tp: int = 1) -> int:
     multiple of lcm(8, tp). The fixed 8 makes the padding REPRODUCIBLE
     across runs that shard differently (a checkpoint trained at tp=4 must
     restore under tp=1 serving — both sides compute the same number for
-    any tp <= 8, the realistic range here), keeps the embedding divisible
-    for vocab-sharding, and rounds the unembed matmul toward MXU tiles.
-    The padded rows are never produced by encode() and never sampled from
-    a trained model (their logits only see gradient through softmax mass).
-    tp > 8 still pads correctly for training but needs the SAME tp at
-    serving — padded_vocab is deliberately tp-stable only up to 8."""
+    any tp DIVIDING 8, i.e. 1/2/4/8, the realistic TPU mesh sizes), keeps
+    the embedding divisible for vocab-sharding, and rounds the unembed
+    matmul toward MXU tiles. The padded rows are never produced by
+    encode() and never sampled from a trained model (their logits only see
+    gradient through softmax mass). Any OTHER tp (3, 5, 6, 7, or > 8)
+    pads to lcm(8, tp) — correct for training, but the SAME tp is then
+    required at serving; cross-tp portability holds only within
+    {1, 2, 4, 8}."""
     m = 8
     while m % tp:  # lcm(8, tp) for the tp > 8 case
         m += 8

@@ -64,14 +64,12 @@ def main(argv=None):
     tok = None
     vocab = 256  # tiny = byte tokens
     if cfg.bpe:
-        from dsml_tpu.utils.tokenizer import BPETokenizer
-
-        from dsml_tpu.utils.tokenizer import padded_vocab
+        from dsml_tpu.utils.tokenizer import BPETokenizer, padded_vocab
 
         tok = BPETokenizer.load(cfg.bpe)
         # the SAME tp-stable padding rule train_gpt2 used, so the
-        # checkpoint's embedding/head shapes match for any tp <= 8 on
-        # either side
+        # checkpoint's embedding/head shapes match for any tp in {1,2,4,8}
+        # on either side (other tp values need the same tp at both ends)
         vocab = padded_vocab(tok.vocab_size, cfg.tp)
         log.info("BPE tokenizer %s: vocab %d (model vocab %d)",
                  cfg.bpe, tok.vocab_size, vocab)

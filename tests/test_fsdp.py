@@ -118,7 +118,37 @@ def test_hybrid_fsdp_matches_pure_dp(devices8):
     # params really live 4-way sharded under the hybrid step too
     w = params["layers"][0]["attn"]["wqkv"]
     assert w.addressable_shards[0].data.size * 4 == w.size
-    got, _ = run(MeshSpec(dp=1, fsdp=2, sp=2, tp=2))
+
+
+@pytest.mark.slow
+def test_hybrid_fsdp_sp_tp_matches_pure_dp(devices8):
+    """The four-axis fsdp x sp x tp composition (split out of the default
+    fsdp pin to keep the default suite inside the CI budget — the core
+    ZeRO gather/reduce-scatter path stays default above)."""
+    import optax
+
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.parallel.hybrid import init_hybrid, make_hybrid_train_step
+
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    opt = optax.adam(1e-2)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, cfg.vocab_size, (8, cfg.max_seq)).astype(np.int32)
+    y = np.roll(x, -1, 1).astype(np.int32)
+
+    def run(spec):
+        mesh = build_mesh(spec, devices8)
+        step = make_hybrid_train_step(model, opt, mesh, attn_impl="ring")
+        params, ostate = init_hybrid(model, opt, mesh, seed=0)
+        out = []
+        for _ in range(4):
+            params, ostate, loss = step(params, ostate, x, y)
+            out.append(float(loss))
+        return out
+
+    ref = run(MeshSpec(dp=8))
+    got = run(MeshSpec(dp=1, fsdp=2, sp=2, tp=2))
     np.testing.assert_allclose(got, ref, rtol=2e-3)
 
 

@@ -111,6 +111,7 @@ def test_temperature_sampling_is_slot_independent():
     assert serve(1) == serve(2)
 
 
+@pytest.mark.slow
 def test_top_k_top_p_sampling_is_schedule_independent():
     """top_k/top_p truncation rides the shared sample_token_logits (the
     same function generate uses), and stays slot/quantum-independent:
@@ -506,6 +507,7 @@ def test_latency_stats_track_requests():
     assert stats["gap_p50_s"] > 0 and stats["gap_p99_s"] >= stats["gap_p50_s"]
 
 
+@pytest.mark.slow
 def test_prefix_cache_tokens_identical_and_prefill_work_drops():
     """register_prefix: prompts sharing a registered head admit by copying
     the stored rows and chunk-prefilling only the suffix — tokens equal
@@ -678,6 +680,7 @@ def test_turbo_factor_tokens_identical_and_engages():
     assert sb == st and srv2.n_turbo_ticks > 0
 
 
+@pytest.mark.slow
 def test_turbo_respects_eos_and_admissions():
     """An EOS mid-turbo retires the request exactly where the plain
     batcher would (the sampled stream makes the tokens non-degenerate —

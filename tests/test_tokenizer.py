@@ -101,10 +101,13 @@ def test_vocab_size_validation():
 def test_padded_vocab_is_tp_stable():
     from dsml_tpu.utils.tokenizer import padded_vocab
 
-    # identical for every tp <= 8 — the checkpoint-portability contract
+    # identical for every tp DIVIDING 8 — the checkpoint-portability
+    # contract; other tp values pad to lcm(8, tp) and are documented as
+    # requiring the same tp at serving
     for n in [257, 731, 1024, 2050]:
         base = padded_vocab(n, 1)
         assert base % 8 == 0 and base >= n
         for tp in (1, 2, 4, 8):
             assert padded_vocab(n, tp) == base
     assert padded_vocab(2050, 16) == 2064  # tp > 8: lcm respected
+    assert padded_vocab(731, 6) == 744  # lcm(8,6)=24 — NOT portable to tp=1
