@@ -385,16 +385,17 @@ def bench_gpt2_realtext() -> dict:
     else:
         seq, batch, steps, n_layer, d_model, d_ff, dtype = 128, 16, 120, 2, 128, 512, "float32"
 
-    def train_eval(toks, vocab):
-        """Train the row's architecture on ``toks`` (ids < vocab) and return
-        (first_loss, final_loss, eval_loss|None) — shared by the byte-level
-        and BPE variants so their compute budgets are identical."""
+    def train_eval(train_toks, eval_toks, vocab):
+        """Train the row's architecture on pre-split (train, eval) ids and
+        return (first_loss, final_loss, eval_loss|None, n_eval_targets) —
+        shared by the byte-level and BPE variants so both run the same
+        trunk/steps/batch/seq (the split happens OUTSIDE so the BPE variant
+        can hold out the same text rather than re-carving in id space)."""
         cfg = GPT2Config(
             vocab_size=vocab, max_seq=seq, n_layer=n_layer, n_head=8,
             d_model=d_model, d_ff=d_ff, dtype=dtype, xent_chunk=0,
         )
         model = GPT2(cfg)
-        train_toks, eval_toks = carve_lm_eval_split(toks, seq, batch)
         dev = jax.devices()[0]
         optimizer = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(3e-4))
         params = jax.device_put(model.init(0), dev)
@@ -411,6 +412,7 @@ def bench_gpt2_realtext() -> dict:
             params, opt_state, loss = train_step(params, opt_state, x, y)
             losses.append(float(loss))
         ev = None
+        n_targets = 0
         if eval_toks is not None:
             # held-out loss on non-overlapping windows of the eval tail
             eval_loss_fn = jax.jit(model.loss)
@@ -424,11 +426,13 @@ def bench_gpt2_realtext() -> dict:
                     [eval_toks[(i + j) * seq + 1 : (i + j) * seq + seq + 1] for j in range(batch)]
                 ).astype(np.int32)
                 ev_losses.append(float(eval_loss_fn(params, xs, ys)))
+                n_targets += batch * seq
             if ev_losses:
                 ev = float(np.mean(ev_losses))
-        return float(np.mean(losses[:10])), float(np.mean(losses[-10:])), ev
+        return float(np.mean(losses[:10])), float(np.mean(losses[-10:])), ev, n_targets
 
-    first, final, ev = train_eval(tokens.astype(np.int32), 256)
+    train_b, eval_b = carve_lm_eval_split(tokens.astype(np.int32), seq, batch)
+    first, final, ev, _ = train_eval(train_b, eval_b, 256)
     out = {
         "gpt2_realtext_first_loss": round(first, 4),
         "gpt2_realtext_final_loss": round(final, 4),
@@ -446,31 +450,47 @@ def bench_gpt2_realtext() -> dict:
         # the BPE row below comparable to this one
         out["gpt2_realtext_eval_bpb"] = round(ev / float(np.log(2)), 4)
 
-    # BPE variant at the IDENTICAL compute budget (same arch, steps, batch,
-    # seq): each position now carries ~2.6 bytes of text, so the model sees
-    # ~2.6x more prose per step; bpb on the same held-out text decides
-    # whether that buys quality. Skipped when the budget is tight.
-    if not _skip_for_budget(out, "gpt2_realtext_bpe", 240):
+    # BPE variant at a MATCHED step budget (same trunk/steps/batch/seq;
+    # the 2048-vocab embed/unembed adds ~14% step FLOPs at this d_model —
+    # the standard larger-vocab cost, stated rather than hidden): each
+    # position carries ~3 bytes of text, so the model sees ~3x more prose
+    # per step; bpb on the SAME held-out text decides whether that buys
+    # quality. The tokenizer trains on the TRAIN text only (no eval
+    # leakage), and the bpb denominator is the eval windows' exact byte
+    # count. Skipped when the budget is tight.
+    if eval_b is not None and not _skip_for_budget(out, "gpt2_realtext_bpe", 240):
         try:
             from dsml_tpu.utils.tokenizer import BPETokenizer, padded_vocab
 
-            text = bytes(tokens).decode("utf-8", errors="replace")
-            tok = BPETokenizer.train(text, vocab_size=2048)
-            ids = tok.encode_array(text)
-            bytes_per_token = len(tokens) / max(len(ids), 1)
-            bfirst, bfinal, bev = train_eval(ids, padded_vocab(tok.vocab_size))
+            train_text = bytes(train_b.astype(np.uint8)).decode("utf-8", errors="replace")
+            eval_text = bytes(eval_b.astype(np.uint8)).decode("utf-8", errors="replace")
+            tok = BPETokenizer.train(train_text, vocab_size=2048)
+            train_ids = tok.encode_array(train_text)
+            eval_ids = tok.encode_array(eval_text)
+            bytes_per_token = len(train_b) / max(len(train_ids), 1)
+            bfirst, bfinal, bev, n_targets = train_eval(
+                train_ids, eval_ids, padded_vocab(tok.vocab_size)
+            )
             out.update({
                 "gpt2_realtext_bpe_vocab": tok.vocab_size,
                 "gpt2_realtext_bpe_bytes_per_token": round(bytes_per_token, 2),
                 "gpt2_realtext_bpe_first_loss": round(bfirst, 4),
                 "gpt2_realtext_bpe_final_loss": round(bfinal, 4),
             })
-            if bev is not None:
+            if bev is not None and n_targets:
+                # exact per-byte normalization: total nats over the eval
+                # windows' target tokens divided by those tokens' OWN byte
+                # length (window i targets ids [i*seq+1, i*seq+seq])
+                target_bytes = 0
+                n_win_used = n_targets // seq
+                for w in range(n_win_used):
+                    span = eval_ids[w * seq + 1 : w * seq + seq + 1]
+                    target_bytes += sum(len(tok.token_bytes(int(t))) for t in span)
                 out["gpt2_realtext_bpe_eval_loss"] = round(bev, 4)
-                # per-token loss → per-byte bits through the measured
-                # compression ratio of this corpus
                 out["gpt2_realtext_bpe_eval_bpb"] = round(
-                    bev / bytes_per_token / float(np.log(2)), 4)
+                    bev * n_targets / max(target_bytes, 1) / float(np.log(2)), 4)
+                out["gpt2_realtext_bpe_eval_bytes_per_token"] = round(
+                    target_bytes / n_targets, 2)
         except Exception as e:
             out["gpt2_realtext_bpe_error"] = repr(e)[:200]
     return out

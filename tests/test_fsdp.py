@@ -86,10 +86,11 @@ def test_fsdp_gpt2_trains_sharded(devices8):
 
 
 def test_hybrid_fsdp_matches_pure_dp(devices8):
-    """FSDP inside the HYBRID (shard_map) step: every fsdp × dp/tp/sp mesh
-    shape reproduces the pure-DP loss trajectory while holding params
-    genuinely sharded — the gather-JIT / reduce-scatter-transpose path
-    (VERDICT r2 item 2)."""
+    """FSDP inside the HYBRID (shard_map) step: the dp x fsdp mesh
+    reproduces the pure-DP loss trajectory while holding params genuinely
+    sharded — the gather-JIT / reduce-scatter-transpose path (VERDICT r2
+    item 2). The four-axis fsdp x sp x tp shape runs under -m slow
+    (test_hybrid_fsdp_sp_tp_matches_pure_dp)."""
     import optax
 
     from dsml_tpu.models.gpt2 import GPT2, GPT2Config
