@@ -691,7 +691,8 @@ class ContinuousBatcher:
         online-serving metrics; throughput alone hides queueing and
         head-of-line behavior. ``gap_*`` percentiles are over PER-EMISSION
         gap samples pooled across requests (with ``decode_quantum=k`` one
-        emission carries up to k tokens — divide by the quantum for a
+        emission carries up to k tokens — up to ``k * turbo_factor`` on a
+        turbo tick — so divide by the emission's token count for a
         per-token figure)."""
         out = {"n_requests": len(self._latency)}
         if not self._latency:
