@@ -118,10 +118,11 @@ def _p50_wall(fn, reps: int = 5) -> float:
 
 def bench_gpt2() -> dict:
     """Flagship: GPT-2-small (125M) jitted train step — bf16, Pallas flash
-    attention (512-blocks), dense-logit xent, adamw with donated state (the
-    probed winners; see module docstring). Tokens/sec/chip + MFU, plus a
-    seq-8192 long-context row. Synthetic token data — throughput/MFU only,
-    no quality claim (labeled in provenance)."""
+    attention (hardware-swept auto blocks), dense-logit xent, adamw with
+    donated state (the probed winners; see module docstring).
+    Tokens/sec/chip + MFU, plus seq-8192 and seq-16384 long-context rows.
+    Synthetic token data — throughput/MFU only, no quality claim (labeled
+    in provenance)."""
     # each sub-row delegates to the SAME section helper the --section CLI
     # runs, so the full-run and resumable-capture paths cannot drift apart
     out = _section_gpt2_small()
@@ -142,12 +143,20 @@ def bench_gpt2() -> dict:
             out["gpt2_decode_error"] = repr(e)[:200]
     # scale row: GPT-2-medium (350M) — MFU climbs with model size (less of
     # the step is the small-matmul/vocab tail), the don't-stop-at-parity
-    # evidence beyond the BASELINE flagship. Last: biggest compile (~130 s)
+    # evidence beyond the BASELINE flagship
     if not _skip_for_budget(out, "gpt2_medium", 300):
         try:
             out.update(_section_gpt2_medium())
         except Exception as e:
             out["gpt2_medium_error"] = repr(e)[:200]
+    # stretch LAST: 16k tokens in one sequence, still single-chip, no remat
+    # — a tight budget must drop this row before the higher-signal
+    # decode/medium rows above
+    if not _skip_for_budget(out, "gpt2_seq16k", 180):
+        try:
+            out.update(_section_gpt2_seq16k())
+        except Exception as e:
+            out["gpt2_seq16k_error"] = repr(e)[:200]
     return out
 
 
@@ -349,7 +358,7 @@ def _gpt2_train_throughput(
         "batch": batch,
         "seq": seq,
         "dtype": "bfloat16",
-        "attn": "pallas_flash_auto",  # swept blocks: 512x512 short, 512x1024 at kv>=4096
+        "attn": "pallas_flash_auto",  # swept blocks: 512x512 short, 1024x1024 at len>=4096
         "donate": True,
         "compile_s": round(compile_s, 1),
         "timing_mode": timing_mode,
@@ -1239,6 +1248,19 @@ def _section_gpt2_small() -> dict:
     return {f"gpt2_{k}": v for k, v in res.items()}
 
 
+def _section_gpt2_seq16k() -> dict:
+    """Long-context stretch row: 16k tokens in ONE sequence on one chip,
+    no remat (flash + chunked-vocab CE keep activations inside HBM) —
+    double the seq8k row's length; the auto 1024x1024 flash blocks apply."""
+    long = _gpt2_train_throughput(batch=1, seq=16384, xent_chunk=4096, k_extra=2, reps=5)
+    return {
+        "gpt2_seq16k_tokens_per_sec": long["tokens_per_sec"],
+        "gpt2_seq16k_mfu": long["mfu"],
+        "gpt2_seq16k_step_ms": long["step_ms"],
+        "gpt2_seq16k_compile_s": long["compile_s"],
+    }
+
+
 def _section_gpt2_seq8k() -> dict:
     long = _gpt2_train_throughput(batch=1, seq=8192, xent_chunk=8192, k_extra=3, reps=6)
     return {
@@ -1270,6 +1292,7 @@ def _section_gpt2_medium() -> dict:
 _SECTIONS = {
     "gpt2": _section_gpt2_small,
     "gpt2_seq8k": _section_gpt2_seq8k,
+    "gpt2_seq16k": _section_gpt2_seq16k,
     "gpt2_decode": bench_gpt2_decode,
     "gpt2_medium": _section_gpt2_medium,
     "mnist": bench_mnist,
