@@ -778,9 +778,12 @@ def _bench_serving_llama_kvquant(on_tpu: bool) -> dict:
     params = jax.device_put(model.init(0), jax.devices()[0])
     rng = np.random.default_rng(1)
 
+    # turbo: the drain is all-slots-at-once steady-state decode, exactly
+    # the escalation's regime — dispatches drop ~turbo x after admission
+    turbo = 4
     srv = ContinuousBatcher(
         model, params, n_slots=n_slots, prompt_buckets=(prompt_len,),
-        decode_quantum=quantum,
+        decode_quantum=quantum, turbo_factor=turbo,
     )
 
     def run_once(n_tokens):
@@ -792,7 +795,7 @@ def _bench_serving_llama_kvquant(on_tpu: bool) -> dict:
         out = srv.run()
         return sum(len(t) for t in out.values())
 
-    run_once(2)  # compile
+    run_once(quantum * (turbo + 1) + 1)  # compile prefill + BOTH decode programs
     t0 = time.monotonic()
     total = run_once(n_new)
     wall = time.monotonic() - t0
@@ -804,6 +807,7 @@ def _bench_serving_llama_kvquant(on_tpu: bool) -> dict:
         ),
         "serving_llama_kvquant_slots": n_slots,
         "serving_llama_kvquant_new_tokens": n_new,
+        "serving_llama_kvquant_turbo_factor": turbo,
     }
 
 

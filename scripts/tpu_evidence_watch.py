@@ -32,11 +32,11 @@ SECTIONS = [
     ("gpt2_decode", 1200),  # plain + wq8 + kv8 + kv4 variants, 2 compiles each
     ("allreduce", 600),   # incl. the e2e wire-path row (VERDICT r3 item 7)
     ("gpt2_seq8k", 900),
-    ("gpt2_seq16k", 900),
     ("mnist", 600),
-    ("gpt2_medium", 1200),  # biggest compile (~130 s) last
+    ("gpt2_medium", 1200),  # biggest compile (~130 s)
     ("realtext", 1200),
     ("serving", 1800),  # many programs: chunk/decode/static/spec/llama+verify
+    ("gpt2_seq16k", 900),  # stretch row LAST — lowest marginal signal
 ]
 
 PROBE = (
