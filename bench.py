@@ -149,9 +149,15 @@ def bench_gpt2() -> dict:
             out.update(_section_gpt2_medium())
         except Exception as e:
             out["gpt2_medium_error"] = repr(e)[:200]
-    # stretch LAST: 16k tokens in one sequence, still single-chip, no remat
-    # — a tight budget must drop this row before the higher-signal
-    # decode/medium rows above
+    # scale stretch: GPT-2-large (774M) on one chip — the heaviest compile
+    # in the bench, so it must not starve the rows above
+    if not _skip_for_budget(out, "gpt2_large", 420):
+        try:
+            out.update(_section_gpt2_large())
+        except Exception as e:
+            out["gpt2_large_error"] = repr(e)[:200]
+    # length stretch LAST: 16k tokens in one sequence, still single-chip,
+    # no remat — a tight budget must drop this row before those above
     if not _skip_for_budget(out, "gpt2_seq16k", 180):
         try:
             out.update(_section_gpt2_seq16k())
@@ -1252,6 +1258,24 @@ def _section_gpt2_small() -> dict:
     return {f"gpt2_{k}": v for k, v in res.items()}
 
 
+def _section_gpt2_large() -> dict:
+    """Scale row: GPT-2-large (774M) trains on ONE chip — params + Adam
+    moments + grads land ~11 GB in the 16 GB HBM with no remat, and MFU
+    climbs past medium's (the vocab/small-matmul tail keeps shrinking).
+    The heaviest compile in the bench (~200 s on the tunnel) — runs late
+    and budget-gated."""
+    big = _gpt2_train_throughput(batch=4, seq=1024, xent_chunk=8192, k_extra=2,
+                                 reps=5, preset="large")
+    return {
+        "gpt2_large_tokens_per_sec": big["tokens_per_sec"],
+        "gpt2_large_mfu": big["mfu"],
+        "gpt2_large_step_ms": big["step_ms"],
+        "gpt2_large_params": big["params"],
+        "gpt2_large_batch": big["batch"],
+        "gpt2_large_compile_s": big["compile_s"],
+    }
+
+
 def _section_gpt2_seq16k() -> dict:
     """Long-context stretch row: 16k tokens in ONE sequence on one chip,
     no remat (flash + chunked-vocab CE keep activations inside HBM) —
@@ -1297,6 +1321,7 @@ _SECTIONS = {
     "gpt2": _section_gpt2_small,
     "gpt2_seq8k": _section_gpt2_seq8k,
     "gpt2_seq16k": _section_gpt2_seq16k,
+    "gpt2_large": _section_gpt2_large,
     "gpt2_decode": bench_gpt2_decode,
     "gpt2_medium": _section_gpt2_medium,
     "mnist": bench_mnist,

@@ -33,9 +33,10 @@ SECTIONS = [
     ("allreduce", 600),   # incl. the e2e wire-path row (VERDICT r3 item 7)
     ("gpt2_seq8k", 900),
     ("mnist", 600),
-    ("gpt2_medium", 1200),  # biggest compile (~130 s)
+    ("gpt2_medium", 1200),  # large compile (~130 s)
     ("realtext", 1200),
     ("serving", 1800),  # many programs: chunk/decode/static/spec/llama+verify
+    ("gpt2_large", 1500),  # 774M scale row; heaviest compile (~200 s)
     ("gpt2_seq16k", 900),  # stretch row LAST — lowest marginal signal
 ]
 
