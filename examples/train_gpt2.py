@@ -62,6 +62,10 @@ class GPT2TrainConfig(Config):
     tp: int = field(1, help="tensor-parallel size")
     attn: str = field("ring", help="attention impl: ring | ulysses | ulysses_flash | ring_flash | flash | xla (flash variants = Pallas kernels)")
     lr: float = field(3e-4, help="peak learning rate")
+    optimizer: str = field("adamw", help="adamw | adafactor (factored second "
+                           "moments — O(rows+cols) state instead of two full "
+                           "f32 moment trees; with --remat this is what fits "
+                           "GPT-2-XL/1.5B on one 16GB chip)")
     clip_norm: float = field(1.0, help="global-norm gradient clip (0 = off)")
     warmup_steps: int = field(10, help="linear warmup steps")
     seed: int = field(0, help="init/data seed")
@@ -244,7 +248,12 @@ def main(argv=None):
     # clip_norm value (identity when off) so the opt_state pytree structure
     # — and therefore checkpoint resume — doesn't depend on the flag
     clip = optax.clip_by_global_norm(cfg.clip_norm) if cfg.clip_norm > 0 else optax.identity()
-    optimizer = optax.chain(clip, optax.adamw(schedule_fn))
+    if cfg.optimizer == "adafactor":
+        optimizer = optax.chain(clip, optax.adafactor(schedule_fn))
+    elif cfg.optimizer == "adamw":
+        optimizer = optax.chain(clip, optax.adamw(schedule_fn))
+    else:
+        raise SystemExit(f"unknown --optimizer {cfg.optimizer!r} (adamw | adafactor)")
     step = make_hybrid_train_step(
         model, optimizer, mesh, attn_impl=cfg.attn, grad_accum=cfg.grad_accum,
         n_microbatches=n_micro, schedule=cfg.schedule,

@@ -36,7 +36,8 @@ SECTIONS = [
     ("gpt2_medium", 1200),  # large compile (~130 s)
     ("realtext", 1200),
     ("serving", 1800),  # many programs: chunk/decode/static/spec/llama+verify
-    ("gpt2_large", 1500),  # 774M scale row; heaviest compile (~200 s)
+    ("gpt2_large", 1500),  # 774M scale row (~200 s compile)
+    ("gpt2_xl", 1800),  # 1.5B adafactor+remat row; heaviest compile (~250 s)
     ("gpt2_seq16k", 900),  # stretch row LAST — lowest marginal signal
 ]
 

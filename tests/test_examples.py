@@ -29,6 +29,28 @@ def test_gpt2_example_trains_and_loss_drops():
     assert result["last_loss"] < result["first_loss"] - 0.05
 
 
+def test_gpt2_example_adafactor_remat_trains():
+    """The XL-on-one-chip recipe's ingredients (adafactor factored state +
+    remat) compose with the hybrid step and actually train — the same flag
+    path the bench's gpt2_xl row and the README recipe use, at toy scale."""
+    import train_gpt2
+
+    result = train_gpt2.main(
+        [
+            "--steps", "12",
+            "--batch_size", "8",
+            "--grad_accum", "1",
+            "--optimizer", "adafactor",
+            "--remat", "true",
+            "--seq_len", "64",
+            "--warmup_steps", "2",
+            "--log_every", "6",
+        ]
+    )
+    assert np.isfinite(result["last_loss"])
+    assert result["last_loss"] < result["first_loss"] - 0.02
+
+
 def test_cifar_example_loads_binary_format(tmp_path):
     import train_cifar_resnet
 
