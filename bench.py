@@ -1235,7 +1235,8 @@ def _load_tpu_evidence() -> dict | None:
     return None
 
 
-def _save_tpu_evidence(extras: dict, merge: bool = False, section: str | None = None) -> None:
+def _save_tpu_evidence(extras: dict, merge: bool = False,
+                       section: str | list | None = None) -> None:
     """Persist this run's real-chip numbers as the standing evidence file.
     Only measured TPU-signal runs call this; failures are swallowed — the
     bench's one-line JSON contract outranks the evidence side-channel.
@@ -1259,8 +1260,8 @@ def _save_tpu_evidence(extras: dict, merge: bool = False, section: str | None = 
         prior = _load_tpu_evidence() or {}
         log = prior.pop("capture_log", {})
         prior.pop("captured_at", None)
-        if section:
-            log[section] = now
+        for sec in ([section] if isinstance(section, str) else section or []):
+            log[sec] = now
         keep = {**prior, **keep, "capture_log": log}
     keep["captured_at"] = now
     try:
@@ -1359,6 +1360,9 @@ def _section_gpt2_medium() -> dict:
 # dying mid-capture costs one section, not the whole artifact. The watcher
 # (scripts/tpu_evidence_watch.py) drives these in order whenever the chip
 # probes alive.
+# --section name -> the skip-gate key that doubles as its row prefix
+_SECTION_SKIP_KEY = {"realtext": "gpt2_realtext"}
+
 _SECTIONS = {
     "gpt2": _section_gpt2_small,
     "gpt2_seq8k": _section_gpt2_seq8k,
@@ -1526,7 +1530,46 @@ def main() -> None:
         # measured TPU-signal run: refresh the standing evidence file.
         # merge=True — the file doubles as the section watcher's progress
         # ledger (capture_log), which a full-run overwrite must not reset
-        _save_tpu_evidence(extras, merge=True, section="full_run")
+        # stamp every section this run actually MEASURED (ran un-skipped
+        # and left rows), so backfill labels can trust per-section dates
+        measured = ["full_run"] + [
+            name for name in _SECTIONS
+            if f"{_SECTION_SKIP_KEY.get(name, name)}_skipped" not in extras
+            and any(
+                k.startswith(_SECTION_SKIP_KEY.get(name, name))
+                and not k.endswith(("_error", "_skipped"))
+                for k in extras
+            )
+        ]
+        _save_tpu_evidence(extras, merge=True, section=measured)
+        # budget-skipped sections whose rows the standing evidence already
+        # carries: BACKFILL them into this run's JSON, clearly labeled as
+        # prior per-section captures (same chip, earlier timestamp) — the
+        # driver's artifact should tell the whole story even when its
+        # budget only re-measures the headline
+        evidence = _load_tpu_evidence()
+        if evidence is not None:
+            # skip-gate keys are row PREFIXES; the capture log uses the
+            # --section names, which differ for the realtext rows (and the
+            # BPE sub-row, captured under the same section)
+            log_name = {"gpt2_realtext": "realtext",
+                        "gpt2_realtext_bpe": "realtext"}
+            capture_log = evidence.get("capture_log", {})
+            backfilled = sorted(
+                sec for sec in {
+                    key.rsplit("_skipped", 1)[0]
+                    for key in extras if key.endswith("_skipped")
+                } if log_name.get(sec, sec) in capture_log
+            )
+            for row_k, row_v in evidence.items():
+                if row_k not in extras and any(
+                    row_k.startswith(sec) for sec in backfilled
+                ):
+                    extras[row_k] = row_v
+            if backfilled:
+                extras["evidence_backfilled_sections"] = {
+                    sec: capture_log[log_name.get(sec, sec)] for sec in backfilled
+                }
 
     # honest-evidence labels: what ran on what data (VERDICT r1 item 8)
     extras["data_provenance"] = {
