@@ -265,6 +265,68 @@ def bench_gpt2_decode() -> dict:
     return out
 
 
+def _timed_train_steps(model, optimizer, params, opt_state, x, y,
+                       k_extra: int, reps: int, attn_impl: str = "flash"):
+    """THE train-step timing harness, model-generic: one jitted program per
+    run with k steps chained in a lax.scan, scalar-fetch sync (the only
+    real sync on the tunneled chip), donation-chained reps, and the
+    (1+k)-vs-1 difference cancelling per-dispatch overhead — falling back
+    to absolute time when tunnel jitter makes the difference non-positive.
+    Returns (step_s, timing_mode, compile_s, final_loss). Every train
+    throughput section MUST time through this function so the methodology
+    cannot drift between model families."""
+    import jax
+    import numpy as np
+    import optax
+    from jax import lax
+
+    def loss_fn(p):
+        return model.loss_spmd(p, x, y, attn_impl=attn_impl)
+
+    def train_step(carry, _):
+        p, o = carry
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, o = optimizer.update(grads, o, p)
+        return (optax.apply_updates(p, updates), o), loss
+
+    def make_run(k):
+        def run(p, o):
+            (p, o), losses = lax.scan(train_step, (p, o), None, length=k)
+            return p, o, losses[-1]
+
+        return jax.jit(run, donate_argnums=(0, 1))
+
+    run1, runk = make_run(1), make_run(1 + k_extra)
+    t0 = time.monotonic()
+    state1 = run1(params, opt_state)
+    float(state1[2])  # scalar fetch = the only real sync on the tunneled chip
+    statek = runk(*state1[:2])
+    float(statek[2])
+    compile_s = time.monotonic() - t0
+
+    def p50(fn, state):
+        # donation consumes the inputs — chain each rep off the previous
+        # output (same shardings, so timing is steady-state)
+        ts = []
+        for _ in range(reps):
+            t0 = time.monotonic()
+            state = fn(*state[:2])
+            float(state[2])
+            ts.append(time.monotonic() - t0)
+        return float(np.percentile(ts, 50)), state
+
+    tk, statek = p50(runk, statek)
+    t1, state1 = p50(run1, statek)
+    loss = float(state1[2])
+    if tk - t1 > 1e-3:
+        step_s = (tk - t1) / k_extra
+        timing_mode = "differenced"  # per-dispatch overhead cancelled
+    else:
+        step_s = tk / (1 + k_extra)
+        timing_mode = "absolute"
+    return step_s, timing_mode, compile_s, loss
+
+
 def _gpt2_train_throughput(
     batch: int, seq: int, xent_chunk: int, k_extra: int = 4, reps: int = 10,
     preset: str = "small", optimizer: str = "adamw", remat: bool = False,
@@ -273,7 +335,6 @@ def _gpt2_train_throughput(
     import jax.numpy as jnp
     import numpy as np
     import optax
-    from jax import lax
 
     from dsml_tpu.models.gpt2 import GPT2, GPT2Config
 
@@ -307,51 +368,9 @@ def _gpt2_train_throughput(
     )
     y = jnp.roll(x, -1, axis=1)
 
-    def loss_fn(p):
-        return model.loss_spmd(p, x, y, attn_impl="flash")
-
-    def train_step(carry, _):
-        p, o = carry
-        loss, grads = jax.value_and_grad(loss_fn)(p)
-        updates, o = optimizer.update(grads, o, p)
-        return (optax.apply_updates(p, updates), o), loss
-
-    def make_run(k):
-        def run(p, o):
-            (p, o), losses = lax.scan(train_step, (p, o), None, length=k)
-            return p, o, losses[-1]
-
-        return jax.jit(run, donate_argnums=(0, 1))
-
-    run1, runk = make_run(1), make_run(1 + k_extra)
-
-    t0 = time.monotonic()
-    state1 = run1(params, opt_state)
-    float(state1[2])  # scalar fetch = the only real sync on the tunneled chip
-    statek = runk(*state1[:2])
-    float(statek[2])
-    compile_s = time.monotonic() - t0
-
-    def p50(fn, state):
-        # donation consumes the inputs — chain each rep off the previous
-        # output (same shardings, so timing is steady-state)
-        ts = []
-        for _ in range(reps):
-            t0 = time.monotonic()
-            state = fn(*state[:2])
-            float(state[2])
-            ts.append(time.monotonic() - t0)
-        return float(np.percentile(ts, 50)), state
-
-    tk, statek = p50(runk, statek)
-    t1, state1 = p50(run1, statek)
-    loss = state1[2]
-    if tk - t1 > 1e-3:
-        step_s = (tk - t1) / k_extra
-        timing_mode = "differenced"  # per-dispatch overhead cancelled
-    else:
-        step_s = tk / (1 + k_extra)
-        timing_mode = "absolute"
+    step_s, timing_mode, compile_s, loss = _timed_train_steps(
+        model, optimizer, params, opt_state, x, y, k_extra, reps
+    )
 
     tokens_per_step = batch * seq
     tokens_per_sec = tokens_per_step / step_s
@@ -1247,7 +1266,7 @@ def _save_tpu_evidence(extras: dict, merge: bool = False,
     independently runnable/resumable)."""
     keep = {
         k: v for k, v in extras.items()
-        if (k.startswith(("gpt2_", "mnist_", "allreduce_", "serving_"))
+        if (k.startswith(("gpt2_", "llama1b_", "mnist_", "allreduce_", "serving_"))
             or k in ("device", "device_kind"))
         # the virtual-CPU harness rows and skip/error status strings are NOT
         # real-chip measurements — persisting them would resurface CPU
@@ -1319,6 +1338,65 @@ def _section_gpt2_xl() -> dict:
     }
 
 
+def _section_llama1b() -> dict:
+    """Second-family scale row: TinyLlama-1.1B (22x2048, GQA 32q/4kv,
+    SwiGLU, untied head) trains on ONE chip with AdamW — the parallel
+    stack and bench methodology are model-generic, and the analytic FLOP
+    count below is Llama's own (GQA-shrunk kv projections, 3-matmul
+    SwiGLU, untied unembedding)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from dsml_tpu.models.llama import Llama, LlamaConfig
+
+    batch, seq, k_extra, reps = 2, 2048, 2, 5
+    cfg = dataclasses.replace(
+        LlamaConfig.tinyllama_1b(), dtype="bfloat16", max_seq=seq, xent_chunk=8192
+    )
+    model = Llama(cfg)
+    dev = jax.devices()[0]
+    params = jax.device_put(model.init(0), dev)
+    n_params = model.n_params(params)
+    optimizer = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = jax.device_put(optimizer.init(params), dev)
+    rng = np.random.default_rng(0)
+    x = jax.device_put(
+        jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32), dev
+    )
+    y = jnp.roll(x, -1, axis=1)
+
+    step_s, timing_mode, compile_s, loss = _timed_train_steps(
+        model, optimizer, params, opt_state, x, y, k_extra, reps
+    )
+
+    T = batch * seq
+    d, ff, L, V = cfg.d_model, cfg.d_ff, cfg.n_layer, cfg.vocab_size
+    kv_frac = cfg.n_kv_head / cfg.n_head
+    fwd = L * (
+        2 * T * d * d              # q projection
+        + 2 * 2 * T * d * d * kv_frac  # k and v projections (GQA-shrunk)
+        + 2 * T * d * d            # attention output projection
+        + 2 * 2 * T * seq * d // 2  # q.k^T and p.v, causal halves the area
+        + 3 * 2 * T * d * ff       # SwiGLU: gate + up + down
+    ) + 2 * T * d * V              # untied unembedding
+    achieved = 3 * fwd / step_s
+    peak = _peak_flops(dev)
+    return {
+        "llama1b_tokens_per_sec": round(T / step_s, 1),
+        "llama1b_mfu": round(achieved / peak, 4) if peak else None,
+        "llama1b_step_ms": round(step_s * 1e3, 2),
+        "llama1b_params": n_params,
+        "llama1b_batch": batch,
+        "llama1b_seq": seq,
+        "llama1b_compile_s": round(compile_s, 1),
+        "llama1b_timing_mode": timing_mode,
+        "llama1b_final_loss": round(loss, 3),
+        "llama1b_model": "TinyLlama-1.1B L22 d2048 GQA32q/4kv bf16 adamw",
+    }
+
+
 def _section_gpt2_seq16k() -> dict:
     """Long-context stretch row: 16k tokens in ONE sequence on one chip,
     no remat (flash + chunked-vocab CE keep activations inside HBM) —
@@ -1369,6 +1447,7 @@ _SECTIONS = {
     "gpt2_seq16k": _section_gpt2_seq16k,
     "gpt2_large": _section_gpt2_large,
     "gpt2_xl": _section_gpt2_xl,
+    "llama1b": _section_llama1b,
     "gpt2_decode": bench_gpt2_decode,
     "gpt2_medium": _section_gpt2_medium,
     "mnist": bench_mnist,
@@ -1504,6 +1583,14 @@ def main() -> None:
             extras.update(bench_serving())
         except Exception as e:
             errors["serving"] = repr(e)[:300]
+    # second-family scale row (TinyLlama-1.1B, one chip): after every
+    # reference-anchored row — it tells the model-generic story, so a tight
+    # budget drops it first among the late rows
+    if not no_tpu_signal and not _skip_for_budget(extras, "llama1b", 420):
+        try:
+            extras.update(_section_llama1b())
+        except Exception as e:
+            errors["llama1b"] = repr(e)[:300]
     if len(jax.devices()) == 1 and not _skip_for_budget(extras, "allreduce_virtual8", 120):
         # multi-chip hosts already measured a ring that hops on real ICI
         extras.update(bench_ring_virtual8())

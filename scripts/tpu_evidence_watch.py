@@ -37,7 +37,8 @@ SECTIONS = [
     ("realtext", 1200),
     ("serving", 1800),  # many programs: chunk/decode/static/spec/llama+verify
     ("gpt2_large", 1500),  # 774M scale row (~200 s compile)
-    ("gpt2_xl", 1800),  # 1.5B adafactor+remat row; heaviest compile (~250 s)
+    ("gpt2_xl", 1800),  # 1.5B adafactor+remat row; heaviest compile (~350 s)
+    ("llama1b", 1500),  # second-family 1.1B scale row
     ("gpt2_seq16k", 900),  # stretch row LAST — lowest marginal signal
 ]
 
