@@ -776,3 +776,25 @@ def test_prefix_cache_small_default():
     ra, rb = serve(True)
     assert ra == _reference(model, params, full, 4)
     assert rb == _reference(model, params, prefix, 3)
+
+
+@pytest.mark.slow
+def test_llama_kvquant_turbo_composition_matches_generate():
+    """The exact composition the bench's serving_llama_kvquant row runs:
+    Llama family + GQA + int8 KV cache + turbo escalation — tokens equal
+    standalone generate and turbo genuinely engages."""
+    import dataclasses
+
+    from dsml_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), max_seq=256, kv_quant=True)
+    model = Llama(cfg)
+    params = model.init(11)
+    prompts = _prompts(cfg, [6, 14], seed=11)
+    srv = ContinuousBatcher(model, params, n_slots=2, prompt_buckets=(16,),
+                            decode_quantum=2, turbo_factor=3)
+    rids = [srv.submit(p, 14) for p in prompts]
+    out = srv.run()
+    for rid, p in zip(rids, prompts):
+        assert out[rid] == _reference(model, params, p, 14), rid
+    assert srv.n_turbo_ticks > 0
