@@ -162,13 +162,19 @@ def bench_gpt2() -> dict:
             out.update(_section_gpt2_xl())
         except Exception as e:
             out["gpt2_xl_error"] = repr(e)[:200]
-    # length stretch LAST: 16k tokens in one sequence, still single-chip,
-    # no remat — a tight budget must drop this row before those above
+    # length stretches LAST: 16k (no remat) then 32k (remat) tokens in one
+    # sequence, still single-chip — a tight budget must drop these before
+    # the rows above
     if not _skip_for_budget(out, "gpt2_seq16k", 180):
         try:
             out.update(_section_gpt2_seq16k())
         except Exception as e:
             out["gpt2_seq16k_error"] = repr(e)[:200]
+    if not _skip_for_budget(out, "gpt2_seq32k", 200):
+        try:
+            out.update(_section_gpt2_seq32k())
+        except Exception as e:
+            out["gpt2_seq32k_error"] = repr(e)[:200]
     return out
 
 
@@ -1338,6 +1344,26 @@ def _section_gpt2_xl() -> dict:
     }
 
 
+def _section_gpt2_seq32k() -> dict:
+    """Maximum-length stretch row: 32,768 tokens in ONE sequence on one
+    chip — remat trades recompute for the activation memory a 32k context
+    needs (analytic MFU does not count the recompute, so the number reads
+    low; 16k fits without remat, see gpt2_seq16k)."""
+    long = _gpt2_train_throughput(batch=1, seq=32768, xent_chunk=4096,
+                                  k_extra=2, reps=4, remat=True)
+    return {
+        "gpt2_seq32k_tokens_per_sec": long["tokens_per_sec"],
+        "gpt2_seq32k_mfu": long["mfu"],
+        "gpt2_seq32k_step_ms": long["step_ms"],
+        "gpt2_seq32k_remat": True,
+        "gpt2_seq32k_compile_s": long["compile_s"],
+        "gpt2_seq32k_note": (
+            "32k context, single chip, remat; analytic MFU excludes the "
+            "remat recompute"
+        ),
+    }
+
+
 def _section_llama1b() -> dict:
     """Second-family scale row: TinyLlama-1.1B (22x2048, GQA 32q/4kv,
     SwiGLU, untied head) trains on ONE chip with AdamW — the parallel
@@ -1445,6 +1471,7 @@ _SECTIONS = {
     "gpt2": _section_gpt2_small,
     "gpt2_seq8k": _section_gpt2_seq8k,
     "gpt2_seq16k": _section_gpt2_seq16k,
+    "gpt2_seq32k": _section_gpt2_seq32k,
     "gpt2_large": _section_gpt2_large,
     "gpt2_xl": _section_gpt2_xl,
     "llama1b": _section_llama1b,

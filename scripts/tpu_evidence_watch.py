@@ -39,7 +39,8 @@ SECTIONS = [
     ("gpt2_large", 1500),  # 774M scale row (~200 s compile)
     ("gpt2_xl", 1800),  # 1.5B adafactor+remat row; heaviest compile (~350 s)
     ("llama1b", 1500),  # second-family 1.1B scale row
-    ("gpt2_seq16k", 900),  # stretch row LAST — lowest marginal signal
+    ("gpt2_seq16k", 900),  # length stretch rows LAST — lowest marginal signal
+    ("gpt2_seq32k", 900),
 ]
 
 PROBE = (
