@@ -11,8 +11,10 @@ Design points:
 
 - **Byte base vocabulary** (ids 0-255): any UTF-8 input round-trips exactly
   — no unknown-token path, no normalization of any kind (NFC/NFD inputs
-  round-trip as given). ``decode(encode(s)) == s`` for arbitrary ``s``
-  (pinned in tests, including emoji/CJK and decomposed accents).
+  round-trip as given). ``decode(encode(s)) == s`` for arbitrary valid
+  Unicode ``s`` (pinned in tests, including emoji/CJK and decomposed
+  accents); the one exception is unpaired surrogates — not valid text —
+  which encode as "?" instead of raising.
 - **Pre-tokenization** splits text into word-ish pieces (leading-space
   convention like GPT-2: ``" the"`` is one piece, so merges never cross
   word boundaries and frequent words become single tokens). The piece
@@ -135,10 +137,12 @@ class BPETokenizer:
             )
         piece_freq = Counter(_pieces(text))
         # unique pieces as mutable symbol sequences + their frequencies
+        # (errors="replace" mirrors encode(): a stray unpaired surrogate in
+        # the corpus trains as "?" instead of crashing the trainer)
         words: list[list[int]] = []
         freqs: list[int] = []
         for piece, f in piece_freq.items():
-            words.append(list(piece.encode("utf-8")))
+            words.append(list(piece.encode("utf-8", errors="replace")))
             freqs.append(f)
 
         # incremental pair bookkeeping: recounting every pair after every
@@ -215,7 +219,11 @@ class BPETokenizer:
     def encode(self, text: str) -> list[int]:
         ids: list[int] = []
         for piece in _pieces(text):
-            ids.extend(self._bpe(piece.encode("utf-8")))
+            # errors="replace": an unpaired surrogate (not valid Unicode
+            # text) must not crash the tokenizer — it encodes as "?"
+            # (str.encode's replacement), the one documented exception to
+            # the exact round-trip
+            ids.extend(self._bpe(piece.encode("utf-8", errors="replace")))
         return ids
 
     def encode_array(self, text: str) -> np.ndarray:

@@ -29,6 +29,14 @@ def test_roundtrip_exact_ascii_and_unicode():
         assert tok.decode(tok.encode(s)) == s
 
 
+def test_unpaired_surrogate_does_not_crash():
+    """Unpaired surrogates are not valid Unicode text; they must encode as
+    "?" (the documented round-trip exception) rather than raise."""
+    tok = BPETokenizer.train(CORPUS + " odd \udcff byte", vocab_size=300)
+    ids = tok.encode("bad \ud800 surrogate")
+    assert tok.decode(ids) == "bad ? surrogate"
+
+
 def test_compression_beats_bytes_on_training_distribution():
     tok = BPETokenizer.train(CORPUS, vocab_size=400)
     ids = tok.encode(CORPUS)
