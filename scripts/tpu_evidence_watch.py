@@ -69,9 +69,17 @@ def probe_alive(timeout: float = 120.0) -> bool:
 
 
 def captured_sections() -> set:
+    """Sections whose rows are already fresh. ``TPU_WATCH_REFRESH_BEFORE``
+    (ISO-8601 UTC, e.g. the round's start time) treats any capture older
+    than that as pending, so a new round re-measures every row instead of
+    trusting last round's dates."""
+    cutoff = os.environ.get("TPU_WATCH_REFRESH_BEFORE", "")
     try:
         with open(EVIDENCE) as f:
-            return set(json.load(f).get("capture_log", {}))
+            log_entries = json.load(f).get("capture_log", {})
+        # ISO-8601 Z timestamps compare correctly as strings
+        return {n for n, ts in log_entries.items()
+                if not cutoff or str(ts) >= cutoff}
     except (OSError, ValueError):
         return set()
 
