@@ -1280,11 +1280,13 @@ def _preflight_device() -> bool:
     # patience is ALSO coupled to the run budget: a driver budget below
     # preflight+fallback must shrink the probe phase, not the fallback's
     # room to land a measured row (~60 s reserved)
-    patience = min(
-        _env_float("BENCH_PREFLIGHT_S", 180.0),
-        # floor must clear the 30 s probe-entry threshold below, so even the
-        # tightest budget still probes once before declaring unreachable
-        max(_budget_left() - 60.0, 35.0),
+    # the 35 s floor applies AFTER both terms so even a tiny explicit
+    # BENCH_PREFLIGHT_S or a tight budget still clears the 30 s probe-entry
+    # threshold below — a healthy chip answers in ~5-10 s, and declaring a
+    # live TPU "unreachable" without one probe would mislabel the artifact
+    patience = max(
+        min(_env_float("BENCH_PREFLIGHT_S", 180.0), _budget_left() - 60.0),
+        35.0,
     )
     start = time.monotonic()
 
@@ -1695,11 +1697,12 @@ def _watchdog_loop() -> None:
             continue
         reason = None
         if elapsed >= _BUDGET_S - 15.0 and (
-            stale >= grace_s or elapsed >= _BUDGET_S + 120.0
+            stale >= grace_s or elapsed >= _BUDGET_S - 5.0
         ):
-            # staleness grace: an in-flight section making active progress
-            # (e.g. the mnist-regardless-of-budget fallback) gets up to
-            # 120 s past the soft budget to land its measured row
+            # staleness grace: an actively-progressing section gets a few
+            # more seconds, but the line ALWAYS prints by budget-5 — a
+            # driver timeout equal to the budget must never win the race
+            # (the BENCH_r04 rc=124 shape)
             reason = f"soft budget ({_BUDGET_S:.0f}s) reached before main() emitted"
         elif elapsed >= min(emergency_s, _BUDGET_S - 20.0) and not measured \
                 and stale >= 150.0:

@@ -91,7 +91,9 @@ def test_cpu_fallback_emits_under_hung_probe():
     rc, out, wall = _run_bench(
         {
             "BENCH_SIM_HUNG_PROBE": "1",
-            "BENCH_PREFLIGHT_S": "5",      # give up immediately
+            # clamped up to the 35 s probe floor (one probe always runs);
+            # the hung sim-probe eats exactly that window, then fallback
+            "BENCH_PREFLIGHT_S": "5",
             # comfortably above worst-case CPU mnist wall time, so the
             # watchdog's soft-budget trigger cannot beat the measured row
             "BENCH_FALLBACK_BUDGET_S": "150",
