@@ -659,28 +659,69 @@ def bench_serving() -> dict:
     # timed: streaming arrivals — a third of the requests queue up front
     # (a burst), the rest arrive 2 per tick (Poisson-ish steady stream)
     arrivals = list(zip(prompts, budgets))
-    t0 = time.monotonic()
-    for p, n in arrivals[: n_requests // 3]:
-        srv.submit(p, n)
-    i = n_requests // 3
-    while srv.n_queued or srv.n_active or srv.n_pending or i < n_requests:
-        for p, n in arrivals[i : i + 2]:
-            srv.submit(p, n)
-        i += 2
-        srv.step()
-    out = srv.collect()
-    cont_wall = time.monotonic() - t0
+
+    def n_dispatches(batcher):
+        # every host→device round trip the scheduler pays: decode ticks,
+        # prefill calls, and cache-insert scatters
+        return (batcher.n_plain_ticks + batcher.n_turbo_ticks
+                + batcher.n_adaptive_ticks + batcher.n_prefill_dispatches
+                + batcher.n_insert_dispatches)
+
+    def run_streaming(batcher):
+        d0 = n_dispatches(batcher)
+        t0 = time.monotonic()
+        for p, n in arrivals[: n_requests // 3]:
+            batcher.submit(p, n)
+        i = n_requests // 3
+        while batcher.n_queued or batcher.n_active or batcher.n_pending or i < n_requests:
+            for p, n in arrivals[i : i + 2]:
+                batcher.submit(p, n)
+            i += 2
+            batcher.step()
+        out = batcher.collect()
+        wall = time.monotonic() - t0
+        return out, wall, n_dispatches(batcher) - d0
+
+    out, cont_wall, cont_disp = run_streaming(srv)
     total_tokens = sum(len(t) for t in out.values())
     assert len(out) == n_requests
     # online-serving latency percentiles over the timed streaming workload
     # (warmup requests excluded via the reset)
     latency = srv.latency_stats()
 
+    # adaptive early-exit ticks on the SAME workload: the dispatch bill
+    # collapses to ~O(retirements + admissions) — same tokens (pinned in
+    # tests), fewer host round trips. A failure here must not cost the
+    # rows already measured above (same policy as the turbo sub-row)
+    adaptive_error = None
+    k_max = min(int(2 ** np.ceil(np.log2(new_hi + 1))), cfg.max_seq)
+    try:
+        srv_a = ContinuousBatcher(
+            model, params, n_slots=n_slots, prompt_buckets=buckets,
+            prefill_chunk=chunk, adaptive_quantum=k_max,
+        )
+        srv_a.submit(rng.integers(0, cfg.vocab_size, (prompt_lo,)).astype(np.int32), 2)
+        srv_a.submit(rng.integers(0, cfg.vocab_size, (prompt_hi,)).astype(np.int32), 2)
+        srv_a.run()
+        srv_a.collect()
+        out_a, adapt_wall, adapt_disp = run_streaming(srv_a)
+        adapt_tokens = sum(len(t) for t in out_a.values())
+        if sorted(map(tuple, out_a.values())) != sorted(map(tuple, out.values())):
+            raise AssertionError("adaptive ticks changed tokens")
+    except Exception as e:
+        adaptive_error = repr(e)[:200]
+
+    # per-dispatch host round trip (compile-cached trivial program, scalar
+    # fetch): the quantity that separates scheduler cost from compute cost
+    import jax.numpy as jnp
+
+    trivial = jax.jit(lambda x: x + 1.0)
+    float(trivial(jnp.zeros(())))
+    rtt_s = _p50_wall(lambda: float(trivial(jnp.zeros(()))), reps=7)
+
     # static baseline on the SAME workload: pad every prompt to the longest,
     # one generate per slot-sized batch, everyone waits for the longest
     # budget (what serving WITHOUT continuous batching costs)
-    import jax.numpy as jnp
-
     max_len = max(len(p) for p in prompts)
     max_new = max(budgets)
     group_sizes = {len(prompts[j : j + n_slots]) for j in range(0, n_requests, n_slots)}
@@ -698,11 +739,30 @@ def bench_serving() -> dict:
         got += sum(min(b, toks.shape[1]) for b in budgets[j : j + n_slots])
     static_wall = time.monotonic() - t0
 
+    # decomposition: scheduler cost = dispatches × host RTT; compute cost =
+    # what's left. The rtt0 model subtracts the measured per-dispatch round
+    # trip from every wall — the workload-level comparison a host-local
+    # deployment (RTT ~0) would see, where the batcher's no-padding
+    # advantage is the whole story
+    n_static_disp = (n_requests + n_slots - 1) // n_slots
+    static_rtt0 = max(static_wall - n_static_disp * rtt_s, 1e-6)
+    cont_rtt0 = max(cont_wall - cont_disp * rtt_s, 1e-6)
+
     rows = {
         "serving_continuous_tokens_per_sec": round(total_tokens / cont_wall, 1),
         "serving_static_tokens_per_sec": round(got / static_wall, 1),
         "serving_speedup_vs_static": round(
             (total_tokens / cont_wall) / (got / static_wall), 2),
+        # the dispatch decomposition (VERDICT r4 weak #2): how many host
+        # round trips each scheduler paid for the same tokens, and the
+        # modeled RTT=0 speedup that isolates the workload-level win
+        "serving_dispatches_plain": cont_disp,
+        "serving_dispatches_static": n_static_disp,
+        "serving_dispatches_per_token_plain": round(cont_disp / total_tokens, 3),
+        "serving_host_rtt_ms": round(rtt_s * 1e3, 2),
+        "serving_speedup_vs_static_rtt0_plain": round(
+            (total_tokens / cont_rtt0) / (got / static_rtt0), 2),
+        "serving_adaptive_quantum": k_max,
         "serving_requests": n_requests,
         "serving_total_tokens": total_tokens,
         "serving_slots": n_slots,
@@ -720,13 +780,28 @@ def bench_serving() -> dict:
         "serving_note": (
             "continuous batching pays one host dispatch per scheduler tick; "
             "the static baseline decodes its whole budget inside one jitted "
-            "scan. Under a high per-dispatch RTT (axon tunnel ~100 ms, or "
-            "any CPU fallback) the tick cost dominates and the speedup "
-            "ratio understates what a host-local TPU deployment sees; the "
-            "workload-level win (no padding to the longest prompt/budget) "
-            "is what the ratio measures when dispatch is cheap"
+            "scan. adaptive_quantum (early-exit device loop) collapses the "
+            "dispatch bill to ~retirements+admissions; the residual gap to "
+            "static is the per-dispatch host RTT (serving_host_rtt_ms × "
+            "serving_dispatches_*), which the _rtt0 rows subtract to show "
+            "the workload-level (no-padding) win a host-local deployment "
+            "sees"
         ),
     }
+    if adaptive_error is None:
+        adapt_rtt0 = max(adapt_wall - adapt_disp * rtt_s, 1e-6)
+        rows.update({
+            "serving_adaptive_tokens_per_sec": round(adapt_tokens / adapt_wall, 1),
+            "serving_adaptive_speedup_vs_static": round(
+                (adapt_tokens / adapt_wall) / (got / static_wall), 2),
+            "serving_dispatches_adaptive": adapt_disp,
+            "serving_dispatches_per_token_adaptive": round(
+                adapt_disp / adapt_tokens, 3),
+            "serving_speedup_vs_static_rtt0": round(
+                (adapt_tokens / adapt_rtt0) / (got / static_rtt0), 2),
+        })
+    else:
+        rows["serving_adaptive_error"] = adaptive_error
     rows.update(_bench_serving_turbo(model, params, cfg, on_tpu))
     rows.update(_bench_serving_llama_kvquant(on_tpu))
     rows.update(_bench_speculative(model, params, on_tpu))
@@ -761,41 +836,64 @@ def _bench_serving_turbo(model, params, cfg, on_tpu: bool) -> dict:
     ]
     budgets = rng.integers(new_lo, new_hi + 1, n_requests).tolist()
 
-    def drain(turbo):
+    def make_srv(turbo=0, adaptive=0):
         srv = ContinuousBatcher(
             model, params, n_slots=n_slots, prompt_buckets=(max(64, max_prompt),),
-            decode_quantum=quantum, turbo_factor=turbo,
+            decode_quantum=quantum if not adaptive else 1,
+            turbo_factor=turbo, adaptive_quantum=adaptive,
         )
-        # warmup must compile BOTH decode programs: after the prefill token
-        # the remaining budget is quantum*(turbo+1), so the first tick
-        # escalates (turbo path) and the leftover quantum drains through a
-        # PLAIN tick — without it the plain program would JIT mid-timed-run
+        # warmup must compile EVERY decode program the timed drain can hit:
+        # with turbo, the first tick after prefill escalates (remaining
+        # budget = quantum*(turbo+1)) and the leftover quantum drains
+        # through a PLAIN tick; with adaptive, one early-exit tick covers it
         srv.submit(prompts[0], quantum * (max(turbo, 1) + 1) + 1)
         srv.run()
         srv.collect()
-        p0, t0c = srv.n_plain_ticks, srv.n_turbo_ticks
+        return srv
+
+    def drain(srv):
+        d0 = (srv.n_plain_ticks + srv.n_turbo_ticks + srv.n_adaptive_ticks)
         for p, n in zip(prompts, budgets):
             srv.submit(p, int(n))
         t0 = time.monotonic()
         out = srv.run()
         wall = time.monotonic() - t0
         toks = sum(len(t) for t in out.values())
-        return toks / wall, srv.n_plain_ticks - p0, srv.n_turbo_ticks - t0c
+        ticks = (srv.n_plain_ticks + srv.n_turbo_ticks + srv.n_adaptive_ticks) - d0
+        return toks / wall, ticks
 
+    # repeat each drain and take the MEDIAN: on the tunneled chip a single
+    # drain spans only a handful of dispatches, so one jittery round trip
+    # could move a single-shot ratio well beyond its real value
+    reps = 3
     try:
-        base_tps, base_plain, _ = drain(0)
-        turbo_tps, turbo_plain, turbo_ticks = drain(factor)
+        k_max = min(int(2 ** np.ceil(np.log2(new_hi + 1))), cfg.max_seq)
+        runs = {}
+        for name, kw in (("base", {}), ("turbo", {"turbo": factor}),
+                         ("adaptive", {"adaptive": k_max})):
+            srv = make_srv(**kw)  # one instance per mode: compile once,
+            samples = [drain(srv) for _ in range(reps)]  # then drain reps×
+            runs[name] = (
+                float(np.median([s[0] for s in samples])),
+                int(np.median([s[1] for s in samples])),
+            )
     except Exception as e:  # never fail the whole serving section on this row
         return {"serving_turbo_error": repr(e)[:200]}
+    base_tps, base_ticks = runs["base"]
+    turbo_tps, turbo_ticks = runs["turbo"]
+    adapt_tps, adapt_ticks = runs["adaptive"]
     return {
         "serving_longgen_tokens_per_sec": round(base_tps, 1),
         "serving_longgen_turbo_tokens_per_sec": round(turbo_tps, 1),
+        "serving_longgen_adaptive_tokens_per_sec": round(adapt_tps, 1),
         "serving_turbo_speedup": round(turbo_tps / base_tps, 2),
+        "serving_adaptive_longgen_speedup": round(adapt_tps / base_tps, 2),
         "serving_turbo_factor": factor,
-        "serving_turbo_dispatches": turbo_ticks,
-        "serving_turbo_plain_dispatches": turbo_plain,
-        "serving_longgen_base_dispatches": base_plain,
+        "serving_longgen_base_dispatches": base_ticks,
+        "serving_longgen_turbo_dispatches": turbo_ticks,
+        "serving_longgen_adaptive_dispatches": adapt_ticks,
         "serving_longgen_budget_range": [new_lo, new_hi],
+        "serving_longgen_repeats": reps,
     }
 
 
@@ -1697,12 +1795,13 @@ def _watchdog_loop() -> None:
             continue
         reason = None
         if elapsed >= _BUDGET_S - 15.0 and (
-            stale >= grace_s or elapsed >= _BUDGET_S - 5.0
+            stale >= grace_s or elapsed >= _BUDGET_S - 10.0
         ):
             # staleness grace: an actively-progressing section gets a few
-            # more seconds, but the line ALWAYS prints by budget-5 — a
-            # driver timeout equal to the budget must never win the race
-            # (the BENCH_r04 rc=124 shape)
+            # more seconds, but the hard backstop at budget-10 leaves the
+            # 5 s poll cadence + emit time inside the budget — a driver
+            # timeout equal to the budget must never win the race (the
+            # BENCH_r04 rc=124 shape)
             reason = f"soft budget ({_BUDGET_S:.0f}s) reached before main() emitted"
         elif elapsed >= min(emergency_s, _BUDGET_S - 20.0) and not measured \
                 and stale >= 150.0:

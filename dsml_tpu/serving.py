@@ -139,7 +139,28 @@ class ContinuousBatcher:
     admission. Tokens are IDENTICAL with turbo on or off (the sampler
     folds (request, absolute step) — pinned in tests). A request submitted
     DURING a turbo tick waits out that tick (the trade-off vs the base
-    quantum's admission cadence).
+    quantum's admission cadence) — so keep the turbo quantum
+    (``decode_quantum * turbo_factor`` tokens × the per-token step time)
+    within the deployment's TTFT budget, or use ``adaptive_quantum``,
+    whose early exit removes the trade-off entirely.
+
+    ``adaptive_quantum`` — when >= 2, each decode tick runs an EARLY-EXIT
+    device loop (``lax.while_loop``) of up to that many steps that stops
+    the moment ANY active slot finishes (EOS or token budget). This
+    dissolves the fixed-quantum trade-off: a tick never decodes past a
+    retirement (zero wasted lane-ticks), a freed slot admits on the very
+    next tick (zero admission delay beyond one tick boundary), and in
+    steady state one host dispatch carries up to ``adaptive_quantum``
+    tokens per slot. Dispatch count collapses from O(tokens/quantum) to
+    ~O(retirements + admissions) — the fix for a high per-dispatch host
+    RTT (the axon tunnel's ~100 ms) that a fixed large quantum could only
+    buy by delaying admissions and over-decoding retired slots. Works with
+    greedy and temperature sampling; tokens are IDENTICAL to the plain
+    batcher and to ``generate`` (same chain, sampler folds the absolute
+    step — pinned in tests). While a chunked admission is mid-flight the
+    scheduler drops back to plain ``decode_quantum`` ticks so prefill
+    chunks keep interleaving with decode. Exclusive with ``turbo_factor``
+    and ``speculative_window`` (each sets its own per-tick budget).
 
     ``speculative_window`` — when >= 2, each decode tick runs PROMPT-LOOKUP
     SPECULATIVE decoding across all slots: every active slot drafts
@@ -172,6 +193,7 @@ class ContinuousBatcher:
         prefill_chunk: int = 0,
         speculative_window: int = 0,
         speculative_ngram: int = 2,
+        adaptive_quantum: int = 0,
         mesh=None,
     ):
         """``mesh`` — a framework mesh (``parallel.mesh.build_mesh``) makes
@@ -242,10 +264,26 @@ class ContinuousBatcher:
                 "budget remaining"
             )
         self.turbo_factor = int(turbo_factor)
+        if adaptive_quantum:
+            if adaptive_quantum < 2 or adaptive_quantum > cfg.max_seq:
+                raise ValueError(
+                    f"adaptive_quantum must be in [2, max_seq={cfg.max_seq}] "
+                    f"or 0 (off), got {adaptive_quantum}"
+                )
+            if turbo_factor or speculative_window:
+                raise ValueError(
+                    "adaptive_quantum sets its own early-exit per-tick budget; "
+                    "exclusive with turbo_factor and speculative_window"
+                )
+        self.adaptive_quantum = int(adaptive_quantum)
         # dispatch counters: observability for tests and servers (how often
-        # the turbo escalation actually engages)
+        # the turbo/adaptive escalations actually engage, and what a
+        # workload's host-dispatch bill actually was)
         self.n_plain_ticks = 0
         self.n_turbo_ticks = 0
+        self.n_adaptive_ticks = 0
+        self.n_prefill_dispatches = 0
+        self.n_insert_dispatches = 0
         if speculative_window:
             if speculative_window < 2 or speculative_ngram < 1:
                 raise ValueError(
@@ -307,6 +345,55 @@ class ContinuousBatcher:
             make_decode_k(decode_quantum * turbo_factor) if turbo_factor else None
         )
 
+        def make_decode_until(k_max):
+            """Early-exit decode loop: up to ``k_max`` chained slot-decode
+            steps in ONE dispatch, stopping after the step where any ACTIVE
+            slot finishes (budget reached, or EOS when configured). Returns
+            (toks [k_max, B], n_steps, cache) — the host applies
+            ``toks[:n_steps]``. Same token chain as ``make_decode_k``
+            (sampler folds the absolute step), so tokens are identical."""
+            eos = eos_id
+
+            def decode_until(p, c, t, pos, base_keys, steps_done, remaining,
+                             active):
+                def cond(state):
+                    _, _, _, i, stop, _ = state
+                    return (i < k_max) & ~stop
+
+                def body(state):
+                    c, t, pos, i, stop, toks = state
+                    logits, c = model.decode_step_slots(p, c, t, pos, tp_axis)
+                    if temperature <= 0.0:
+                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    else:
+                        def one(row, key, n_done):
+                            k2 = jax.random.fold_in(key, n_done + i)
+                            return sample_token_logits(
+                                row, k2, temperature, top_k, top_p
+                            )
+
+                        nxt = jax.vmap(one)(logits, base_keys, steps_done)
+                    toks = lax.dynamic_update_index_in_dim(toks, nxt, i, 0)
+                    done = active & (i + 1 >= remaining)
+                    if eos is not None:
+                        done = done | (active & (nxt == eos))
+                    return (c, nxt, jnp.minimum(pos + 1, max_seq - 1),
+                            i + 1, jnp.any(done), toks)
+
+                toks0 = jnp.zeros((k_max, t.shape[0]), jnp.int32)
+                c, _, _, n, _, toks = lax.while_loop(
+                    cond, body,
+                    (c, t, pos, jnp.asarray(0, jnp.int32),
+                     jnp.asarray(False), toks0),
+                )
+                return toks, n, c
+
+            return decode_until
+
+        decode_adaptive = (
+            make_decode_until(adaptive_quantum) if adaptive_quantum else None
+        )
+
         def prefill_fn(p, toks, last):
             return model.prefill(p, toks, tp_axis, last_index=last)
 
@@ -327,6 +414,10 @@ class ContinuousBatcher:
             self._decode_turbo = (
                 jax.jit(decode_turbo, donate_argnums=(1,))
                 if decode_turbo else None
+            )
+            self._decode_adaptive = (
+                jax.jit(decode_adaptive, donate_argnums=(1,))
+                if decode_adaptive else None
             )
             # one prefill compile per bucket length (static last_index
             # would recompile per prompt length — keep it traced)
@@ -370,6 +461,19 @@ class ContinuousBatcher:
             self._decode = _tp_decode_jit(decode_k)
             self._decode_turbo = (
                 _tp_decode_jit(decode_turbo) if decode_turbo else None
+            )
+            self._decode_adaptive = (
+                jax.jit(
+                    jax.shard_map(
+                        decode_adaptive, mesh=mesh,
+                        in_specs=(pspecs, cache_spec, P(), P(), P(), P(),
+                                  P(), P()),
+                        out_specs=(P(), P(), cache_spec),
+                        check_vma=False,
+                    ),
+                    donate_argnums=(1,),
+                )
+                if decode_adaptive else None
             )
             self._prefill = jax.jit(
                 jax.shard_map(
@@ -570,6 +674,8 @@ class ContinuousBatcher:
         logits, cache1 = self._prefill(
             self.params, jnp.asarray(padded), jnp.int32(L - 1)
         )
+        self.n_prefill_dispatches += 1
+        self.n_insert_dispatches += 1
         self._cache = self._insert(self._cache, cache1, slot)
         self._finish_admission(req, slot, logits[0], emitted)
 
@@ -600,10 +706,12 @@ class ContinuousBatcher:
             self.params, cache1, jnp.asarray(padded),
             jnp.int32(start), jnp.int32(last_local),
         )
+        self.n_prefill_dispatches += 1
         if not is_last:
             self._pending = (req, slot, cache1, start + c)
             return False
         self._pending = None
+        self.n_insert_dispatches += 1
         self._cache = self._insert(self._cache, cache1, slot)
         self._finish_admission(req, slot, logits[0], emitted)
         return True
@@ -638,6 +746,7 @@ class ContinuousBatcher:
                     # the whole prompt is the stored prefix: admission
                     # completes with zero prefill work (_insert does not
                     # donate its source, so the master rows stay intact)
+                    self.n_insert_dispatches += 1
                     self._cache = self._insert(self._cache, pcache, slot)
                     self._finish_admission(req, slot, plogits, emitted)
                     continue
@@ -736,6 +845,30 @@ class ContinuousBatcher:
             [len(self._live[rid].tokens) if rid >= 0 else 0 for rid in self._slot_rid],
             np.int32,
         )
+        # adaptive early-exit tick: one dispatch decodes until any active
+        # slot finishes (or k_max) — engaged whenever no chunked admission
+        # is mid-flight (those need the plain quantum's chunk interleave).
+        # A retirement ends the tick, so a queued request admits on the
+        # very next tick: large k_max costs no admission latency
+        if self._decode_adaptive is not None and self._pending is None:
+            remaining = np.full(self.n_slots, self.model.config.max_seq, np.int32)
+            for slot in active:
+                req = self._live[int(self._slot_rid[slot])]
+                remaining[slot] = req.max_new_tokens - len(req.tokens)
+            toks, n_steps, self._cache = self._decode_adaptive(
+                self.params,
+                self._cache,
+                jnp.asarray(self._last_tok),
+                jnp.asarray(self._pos),
+                jnp.asarray(self._slot_key),
+                jnp.asarray(steps_done),
+                jnp.asarray(remaining),
+                jnp.asarray(self._slot_rid >= 0),
+            )
+            self.n_adaptive_ticks += 1
+            quantum = int(n_steps)
+            toks = np.asarray(toks)[:quantum]  # rows past the stop are zeros
+            return self._apply_decoded(emitted, active, toks, quantum)
         # turbo escalation: in steady-state decode (nothing waiting to
         # admit) the escalated program amortizes the per-dispatch host round
         # trip turbo_factor x. Gate on the LARGEST remaining budget: with an
@@ -773,6 +906,12 @@ class ContinuousBatcher:
             jnp.asarray(steps_done),
         )
         toks = np.asarray(toks)  # [quantum, n_slots]
+        return self._apply_decoded(emitted, active, toks, quantum)
+
+    def _apply_decoded(self, emitted: dict, active, toks, quantum: int) -> dict:
+        """Apply one tick's decoded tokens ``toks [quantum, n_slots]`` to
+        the per-slot requests: emit, retire on EOS/budget (truncating a
+        finished slot's tail), and advance continuing slots' positions."""
         for slot in active:
             req = self._live[int(self._slot_rid[slot])]
             new = emitted.setdefault(req.rid, [])
