@@ -60,7 +60,7 @@ def test_watchdog_emits_while_probe_hangs():
             "BENCH_PREFLIGHT_S": "500",   # preflight alone would sit ~500 s
             # the stall trigger (production default 420 s, sized to the XL
             # remote compile) shortened so the suite pays seconds
-            "BENCH_STALL_S": "15",
+            "BENCH_STALL_S": "8",
         },
         timeout=150,
     )

@@ -52,6 +52,7 @@ def test_fsdp_training_matches_dp(devices8):
     np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_fsdp_gpt2_trains_sharded(devices8):
     """The flagship under ZeRO-style sharding: GPT-2 params (and optimizer
     moments) live sharded over fsdp, the first-step loss matches the
@@ -85,6 +86,7 @@ def test_fsdp_gpt2_trains_sharded(devices8):
     }
 
 
+@pytest.mark.slow
 def test_hybrid_fsdp_matches_pure_dp(devices8):
     """FSDP inside the HYBRID (shard_map) step: the dp x fsdp mesh
     reproduces the pure-DP loss trajectory while holding params genuinely

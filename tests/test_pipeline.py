@@ -148,6 +148,7 @@ def _gpt2_tiny_batch(seed=12, batch=8):
     return model, x, y
 
 
+@pytest.mark.slow
 def test_1f1b_step_matches_gpipe(devices8):
     """One SGD step under schedule='1f1b' must produce the same params as
     schedule='gpipe' on the full pp×dp×sp mesh (same grads, same loss)."""

@@ -18,6 +18,31 @@ from dsml_tpu.ops.quantization import (
 )
 
 
+def test_weight_only_int8_small_default():
+    """Default-suite representative of w8a16 serving: GPT-2 prefill logits
+    stay close under per-channel int8 weights, and the plain batcher serves
+    the quantized params token-exactly (the two-family × speculative matrix
+    runs under -m slow)."""
+    from dsml_tpu.models.common import quantize_weights_int8
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.serving import ContinuousBatcher
+
+    model = GPT2(GPT2Config.tiny())
+    params = model.init(23)
+    qp = quantize_weights_int8(params)
+    rng = np.random.default_rng(23)
+    prompt = jnp.asarray(rng.integers(0, 512, (2, 12)), jnp.int32)
+    lf, _ = model.prefill(params, prompt, last_index=11)
+    lq, _ = model.prefill(qp, prompt, last_index=11)
+    np.testing.assert_allclose(np.asarray(lq), np.asarray(lf), atol=0.05, rtol=0)
+
+    ref = np.asarray(model.generate(qp, prompt[:1], 6))[0].tolist()
+    srv = ContinuousBatcher(model, qp, n_slots=2, prompt_buckets=(16,))
+    rid = srv.submit(np.asarray(prompt[0]), 6)
+    assert srv.run()[rid] == ref
+
+
+@pytest.mark.slow
 def test_weight_only_int8_serving_close_and_scheduling_exact():
     """Weight-only int8 (w8a16): quantized params serve every single-device
     decode surface with logits close to full precision, and the batcher's

@@ -61,15 +61,20 @@ def test_plateau_scale_decays_on_stagnant_loss():
 
 
 def test_wrapped_optimizer_trains_quadratic():
+    import jax
+
     opt = wrap_with_plateau(optax.sgd(0.1), patience=3)
     params = jnp.array([2.0, -3.0])
     state = opt.init(params)
-    import jax
 
-    for _ in range(60):
+    @jax.jit
+    def step(params, state):
         loss, g = jax.value_and_grad(lambda p: jnp.sum(p**2))(params)
         updates, state = opt.update(g, state, params, value=loss)
-        params = optax.apply_updates(params, updates)
+        return optax.apply_updates(params, updates), state
+
+    for _ in range(60):
+        params, state = step(params, state)
     assert float(jnp.sum(params**2)) < 1e-3
 
 

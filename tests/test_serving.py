@@ -32,7 +32,10 @@ def test_continuous_batching_matches_generate_gpt2():
     model = GPT2(cfg)
     params = model.init(0)
     prompts = _prompts(cfg, [5, 17, 32, 9, 26])
-    budgets = [6, 3, 8, 5, 4]
+    # budgets repeat values on purpose: each DISTINCT budget costs one
+    # standalone-generate compile in the reference loop below; three
+    # distinct lengths exercise the same retire/admit heterogeneity as five
+    budgets = [5, 3, 6, 5, 3]
 
     srv = ContinuousBatcher(model, params, n_slots=2, prompt_buckets=(8, 16, 32))
     rids = [srv.submit(p, n) for p, n in zip(prompts[:3], budgets[:3])]
@@ -317,6 +320,7 @@ def test_prefill_chunk_chain_matches_whole_prompt_prefill():
                 )
 
 
+@pytest.mark.slow
 def test_chunked_prefill_admission_matches_generate():
     """prefill_chunk is pure scheduling: greedy AND sampled tokens equal
     the whole-prompt batcher and the standalone generate path, across
@@ -444,6 +448,25 @@ def test_prompt_buckets_sorted_and_deduped():
     assert srv.prompt_buckets == (8, 32, 64)
 
 
+def test_speculative_batcher_small_default():
+    """Default-suite representative of the speculative batcher: one serve
+    with drafts on vs off, token-identical (the staggered-arrival × EOS ×
+    chunked-prefill matrix runs under -m slow)."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(17)
+    prompts = _prompts(cfg, [5, 17], seed=17)
+
+    def serve(**kw):
+        srv = ContinuousBatcher(model, params, n_slots=2, prompt_buckets=(32,), **kw)
+        rids = [srv.submit(p, 8) for p in prompts]
+        out = srv.run()
+        return [out[r] for r in rids]
+
+    assert serve(speculative_window=5) == serve()
+
+
+@pytest.mark.slow
 def test_speculative_batcher_matches_plain_and_generate():
     """speculative_window is pure throughput: per-slot prompt-lookup
     drafts + one multi-query verify per tick commit EXACTLY the tokens
@@ -658,7 +681,7 @@ def test_turbo_factor_tokens_identical_and_engages():
     # the middle request retires first; the queued third then admits with a
     # large budget, so once the queue drains every active request still
     # holds >= the turbo quantum (6) and the escalation engages
-    budgets = [40, 12, 38]
+    budgets = [24, 10, 22]
 
     def serve(turbo, temperature=0.0):
         srv = ContinuousBatcher(model, params, n_slots=2,
@@ -729,6 +752,7 @@ def test_turbo_factor_validation():
                           turbo_factor=2)
 
 
+@pytest.mark.slow
 def test_moe_model_through_batcher():
     """A MoE config (top-2 of 4 experts) rides the same slot-decode path:
     batcher tokens equal standalone generate, with turbo escalation on —

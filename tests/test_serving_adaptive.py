@@ -25,7 +25,7 @@ def test_adaptive_tokens_identical_and_dispatches_collapse():
     model = GPT2(cfg)
     params = model.init(11)
     prompts = _prompts(cfg, [5, 17, 32, 9, 26], seed=11)
-    budgets = [24, 3, 40, 5, 17]
+    budgets = [12, 3, 20, 5, 9]
 
     def serve(**kw):
         srv = ContinuousBatcher(model, params, n_slots=2,
@@ -39,8 +39,11 @@ def test_adaptive_tokens_identical_and_dispatches_collapse():
     plain, srv_p = serve()
     adaptive, srv_a = serve(adaptive_quantum=64)
     assert adaptive == plain
-    for tokens, p, n in zip(plain, prompts, budgets):
-        assert tokens == _reference(model, params, p, n)
+    # two reference spot-checks (distinct budgets = distinct generate
+    # compiles, so checking all five would pay 5 compiles for no added
+    # scheduling coverage — plain==adaptive already pins the rest)
+    for i in (0, 2):
+        assert plain[i] == _reference(model, params, prompts[i], budgets[i])
     # plain pays one dispatch per token; adaptive pays ~one per stop event.
     # 5 requests -> 5 retirements; a couple of extra ticks cover admission
     # boundaries. The bound is generous on purpose — the tight claim is
@@ -79,6 +82,7 @@ def test_adaptive_eos_stops_tick_and_admits_next_tick():
     assert len(adaptive) == 3 and all(len(t) >= 1 for t in adaptive)
 
 
+@pytest.mark.slow
 def test_adaptive_with_temperature_matches_plain():
     """Sampled streams are schedule-independent: the sampler folds the
     absolute step, so the early-exit tick boundaries can't change them."""
@@ -97,6 +101,7 @@ def test_adaptive_with_temperature_matches_plain():
     assert serve(adaptive_quantum=32) == serve()
 
 
+@pytest.mark.slow
 def test_adaptive_composes_with_chunked_prefill():
     """While a chunked admission is mid-flight the scheduler drops to plain
     quanta (chunk interleave preserved); tokens stay identical."""

@@ -33,7 +33,14 @@ def _rand_prompt(cfg, batch=2, t=20, seed=1):
     return jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, t)), jnp.int32)
 
 
-@pytest.mark.parametrize("family", ["gpt2", "llama"])
+@pytest.mark.parametrize("family", [
+    "gpt2",
+    # the GQA/Llama variant re-tests the same mechanism through the second
+    # family's verify_step — family×feature composition coverage, kept out
+    # of the default run's budget (speculative stays covered via gpt2,
+    # llama via its own default suite)
+    pytest.param("llama", marks=pytest.mark.slow),
+])
 def test_speculative_equals_greedy_generate(family):
     model = (
         GPT2(GPT2Config.tiny()) if family == "gpt2" else Llama(LlamaConfig.tiny())
@@ -82,6 +89,7 @@ def test_speculative_actually_accepts_drafts():
     assert calls < max_new, f"no drafts accepted in {calls} calls"
 
 
+@pytest.mark.slow
 def test_speculative_with_kv_quant():
     """Speculative verify writes int8 cache rows through the same
     _cache_write path; tokens still equal the quantized greedy decode."""
