@@ -369,10 +369,12 @@ class GPT2:
         """Embedding + transformer block stack → PRE-final-norm hidden
         states [b, s, d]."""
         cfg = self.config
-        if cfg.remat not in (False, True, "int8"):
+        if cfg.remat not in (False, True, "int8", "mlp"):
             # a typo ("INT8", "int4") would otherwise silently degrade to
             # plain remat here and to NO remat in the pipeline path
-            raise ValueError(f"unknown remat mode {cfg.remat!r}; choose False, True, or 'int8'")
+            raise ValueError(
+                f"unknown remat mode {cfg.remat!r}; choose False, True, 'int8', or 'mlp'"
+            )
         block = self._block_closure(tp_axis, sp_axis, attn_impl)
         h = self._embed_spmd(params, tokens, tp_axis, sp_axis, seq_offset)
 
@@ -393,20 +395,28 @@ class GPT2:
                     params["layers"],
                 )
                 outs = pipeline_apply_interleaved(
-                    block, chunks, micro, v, pp_axis, remat=cfg.remat
+                    block, chunks, micro, v, pp_axis,
+                    # "mlp" checkpoints inside the block closure itself
+                    remat=False if cfg.remat == "mlp" else cfg.remat,
                 )
             else:
                 # remat at STAGE granularity (one checkpoint per tick) rather
                 # than per block — the coarser cut bounds in-flight activations
                 # the way 1F1B does
-                outs = pipeline_apply(block, params["layers"], micro, pp_axis, remat=cfg.remat)
+                outs = pipeline_apply(
+                    block, params["layers"], micro, pp_axis,
+                    remat=False if cfg.remat == "mlp" else cfg.remat,
+                )
             h = outs.reshape(b, *h.shape[1:])
         else:
             if cfg.remat == "int8":
                 from dsml_tpu.ops.quantization import compressed_checkpoint
 
                 block = compressed_checkpoint(block)
-            elif cfg.remat:
+            elif cfg.remat is True:
+                # "mlp" (selective) already checkpoints inside _block;
+                # wrapping the whole block again would discard the saved
+                # attention activations it exists to keep
                 block = jax.checkpoint(block)
             for layer in params["layers"]:
                 h = block(layer, h)
@@ -425,13 +435,25 @@ class GPT2:
 
     def _block(self, layer, h, n_head_local, tp_axis, sp_axis, attn_impl):
         """One transformer block (pre-LN attention + MLP/MoE residuals) —
-        the unit the pipeline schedule streams microbatches through."""
+        the unit the pipeline schedule streams microbatches through.
+
+        ``remat="mlp"`` is SELECTIVE rematerialization: only the FFN
+        sub-block is checkpointed, so the backward pass keeps the
+        attention activations (incl. the flash kernel's saved residuals —
+        re-running the O(s²·d) attention forward is the expensive part of
+        whole-block remat at long context) and recomputes just the two
+        cheap O(s·d·ff) FFN matmuls. ~half the activation memory of no
+        remat for ~a tenth of whole-block remat's recompute FLOPs."""
         h = h + self._attn_block(layer, h, n_head_local, tp_axis, sp_axis, attn_impl)
-        if self.config.n_experts:
-            h = h + self._moe_block(layer["moe"], _layer_norm(h, **layer["ln_2"]), tp_axis)
-        else:
-            h = h + self._mlp_block(layer["mlp"], _layer_norm(h, **layer["ln_2"]), tp_axis)
-        return h
+        sub, key = ((self._moe_block, "moe") if self.config.n_experts
+                    else (self._mlp_block, "mlp"))
+
+        def ffn(sub_p, ln_p, hh):
+            return sub(sub_p, _layer_norm(hh, **ln_p), tp_axis)
+
+        if self.config.remat == "mlp":
+            ffn = jax.checkpoint(ffn)
+        return h + ffn(layer[key], layer["ln_2"], h)
 
     _ATTN_IMPLS = ("ring", "ulysses", "ulysses_flash", "ring_flash", "flash", "xla")
 

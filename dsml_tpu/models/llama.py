@@ -317,12 +317,19 @@ class Llama(GPT2):
         return out
 
     def _ffn(self, layer, h, tp_axis=None):
-        x = _rms_norm(h, layer["rms_2"]["scale"], self.config.rms_eps)
-        if self.config.n_experts:
-            # Mixtral-style: the inherited capacity-bounded top-k expert
-            # layer — token payloads ride all_to_all over tp (real EP)
-            return h + self._moe_block(layer["moe"], x, tp_axis)
-        return h + self._mlp_block(layer["mlp"], x, tp_axis)
+        # Mixtral-style MoE: the inherited capacity-bounded top-k expert
+        # layer — token payloads ride all_to_all over tp (real EP)
+        sub, key = ((self._moe_block, "moe") if self.config.n_experts
+                    else (self._mlp_block, "mlp"))
+
+        def ffn(sub_p, scale, hh):
+            return sub(sub_p, _rms_norm(hh, scale, self.config.rms_eps), tp_axis)
+
+        if self.config.remat == "mlp":
+            # selective remat, same contract as GPT2._block: attention
+            # activations stay saved, only the FFN recomputes in backward
+            ffn = jax.checkpoint(ffn)
+        return h + ffn(layer[key], layer["rms_2"]["scale"], h)
 
     def _hidden_spmd(
         self, params, tokens, tp_axis=None, sp_axis=None, attn_impl="ring",

@@ -86,21 +86,28 @@ def _pick_block(seq: int, preferred: int) -> int | None:
 
 
 def _default_blocks(
-    s_q: int, s_kv: int, block_q: int | None, block_k: int | None
+    s_q: int, s_kv: int, block_q: int | None, block_k: int | None,
+    head_dim: int | None = None,
 ) -> tuple[int, int]:
     """Swept-on-hardware block defaults (scripts/flash_block_sweep.py on a
-    v5e, k_extra=16 differenced timing): at sequence lengths >= 4096 the
-    1024x1024 tiling runs the fwd+bwd pair ~1.4x faster than 512x512
-    (43.7 vs 31.2 TFLOPs at seq 8192 — fewer grid revisits of the dq/dkv
-    accumulators); anything wider than 1024 fails TPU compilation (VMEM).
+    v5e, k_extra=16 differenced timing, HEAD_DIM 64 — the GPT-2 shape): at
+    sequence lengths >= 4096 the 1024x1024 tiling runs the fwd+bwd pair
+    ~1.4x faster than 512x512 (43.7 vs 31.2 TFLOPs at seq 8192 — fewer
+    grid revisits of the dq/dkv accumulators); anything wider than 1024
+    already fails TPU compilation on VMEM at d=64. The 1024 widening is
+    therefore GATED on head_dim <= 64: kernel VMEM scales with
+    block x head_dim, so a d=128 model (Llama presets) at the same block
+    could exhaust VMEM outright where the 512 default compiles — wider
+    heads keep 512x512 until a sweep at that head_dim says otherwise.
     Below 4096 the 512x512 tiling measured best-or-equal wherever the
     differenced signal rose above tunnel jitter. Callers can still pin
     blocks explicitly (the ring path does, per-shard); lengths the
     preferred block doesn't divide degrade through _pick_block's ladder."""
+    widen = head_dim is not None and head_dim <= 64
     if block_q is None:
-        block_q = 1024 if s_q >= 4096 else 512
+        block_q = 1024 if (s_q >= 4096 and widen) else 512
     if block_k is None:
-        block_k = 1024 if s_kv >= 4096 else 512
+        block_k = 1024 if (s_kv >= 4096 and widen) else 512
     return block_q, block_k
 
 
@@ -428,7 +435,7 @@ def flash_attention_lse(
     """
     b, h, s_q, d = q.shape
     s_kv = k.shape[2]
-    block_q, block_k = _default_blocks(s_q, s_kv, block_q, block_k)
+    block_q, block_k = _default_blocks(s_q, s_kv, block_q, block_k, d)
     bq = _pick_block(s_q, block_q)
     bk = _pick_block(s_kv, block_k)
     if bq is None or bk is None:
@@ -458,7 +465,8 @@ def flash_attention(
     """
     if q.ndim != 4:
         raise ValueError(f"expected [batch, heads, seq, head_dim], got {q.shape}")
-    block_q, block_k = _default_blocks(q.shape[2], k.shape[2], block_q, block_k)
+    block_q, block_k = _default_blocks(q.shape[2], k.shape[2], block_q, block_k,
+                                       q.shape[3])
     if _pick_block(q.shape[2], block_q) is None or _pick_block(k.shape[2], block_k) is None:
         from dsml_tpu.ops.attention import attention
 
@@ -498,7 +506,8 @@ def ring_flash_attention(
     seq_block = q.shape[-2]
     # per-SHARD kv length decides the block defaults (each hop's flash call
     # sees one shard of K/V)
-    block_q, block_k = _default_blocks(seq_block, seq_block, block_q, block_k)
+    block_q, block_k = _default_blocks(seq_block, seq_block, block_q, block_k,
+                                       q.shape[-1])
     if _pick_block(seq_block, block_q) is None or _pick_block(seq_block, block_k) is None:
         from dsml_tpu.ops.attention import ring_attention
 

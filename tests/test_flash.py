@@ -186,14 +186,22 @@ def test_gpt2_flash_attn_impl_matches_default():
 def test_default_blocks_adapt_to_sequence_lengths():
     """The hardware-swept auto defaults adapt q and kv blocks to their own
     lengths: 512 below 4096, 1024 at or above (scripts/flash_block_sweep.py
-    measured 1.4x on a v5e at 8k); explicit blocks always win."""
+    measured 1.4x on a v5e at 8k, head_dim 64) — but the 1024 widening is
+    GATED on head_dim <= 64 (the swept regime): kernel VMEM scales with
+    block x head_dim, and d=128 at 1024-wide blocks could fail compilation
+    where the 512 default works. Explicit blocks always win."""
     from dsml_tpu.ops.flash import _default_blocks
 
-    assert _default_blocks(1024, 1024, None, None) == (512, 512)
-    assert _default_blocks(2048, 2048, None, None) == (512, 512)
-    assert _default_blocks(4096, 4096, None, None) == (1024, 1024)
-    assert _default_blocks(8192, 8192, None, None) == (1024, 1024)
+    assert _default_blocks(1024, 1024, None, None, 64) == (512, 512)
+    assert _default_blocks(2048, 2048, None, None, 64) == (512, 512)
+    assert _default_blocks(4096, 4096, None, None, 64) == (1024, 1024)
+    assert _default_blocks(8192, 8192, None, None, 64) == (1024, 1024)
     # decode-shaped call: short q against a long cache widens only kv
-    assert _default_blocks(512, 8192, None, None) == (512, 1024)
-    assert _default_blocks(8192, 8192, 256, 512) == (256, 512)
-    assert _default_blocks(8192, 8192, None, 2048) == (1024, 2048)
+    assert _default_blocks(512, 8192, None, None, 64) == (512, 1024)
+    assert _default_blocks(8192, 8192, 256, 512, 64) == (256, 512)
+    assert _default_blocks(8192, 8192, None, 2048, 64) == (1024, 2048)
+    # wider heads (or an unknown head_dim) stay at the safe 512
+    assert _default_blocks(8192, 8192, None, None, 128) == (512, 512)
+    assert _default_blocks(8192, 8192, None, None) == (512, 512)
+    # explicit blocks are never second-guessed, whatever the head_dim
+    assert _default_blocks(8192, 8192, 1024, 1024, 128) == (1024, 1024)

@@ -469,6 +469,13 @@ def test_remat_gradients_identical(hybrid_mesh):
     for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
 
+    # selective remat (FFN-only recompute, attention activations kept)
+    # must be just as math-free
+    sel = GPT2(dataclasses.replace(cfg, remat="mlp"))
+    g2 = jax.jit(jax.grad(sel.loss))(params, x, y)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+
     # and through the sharded hybrid loss
     sharded = jax.shard_map(
         lambda p, xx, yy: lax.pmean(hybrid_loss_fn(remat)(p, xx, yy), ("dp", "sp")),

@@ -195,6 +195,21 @@ def test_gqa_cache_is_kv_heads_only(model):
     assert cache[0]["k"].shape == (2, cfg.n_kv_head // 2, cfg.max_seq, hd)
 
 
+def test_selective_remat_gradients_identical(model):
+    """remat='mlp' checkpoints Llama's FFN through the overridden _ffn —
+    memory only, never math (a silently-ignored mode would also pass a
+    trains-test, so this pins gradient identity against no-remat)."""
+    cfg = model.config
+    x, y = _batch(cfg, batch=2, seed=13)
+    sel = Llama(dataclasses.replace(cfg, remat="mlp"))
+    params = model.init(4)
+    g0 = jax.jit(jax.grad(model.loss))(params, x, y)
+    g1 = jax.jit(jax.grad(sel.loss))(params, x, y)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
 @pytest.mark.slow
 def test_int8_remat_trains(model, hybrid_mesh):
     cfg = dataclasses.replace(model.config, remat="int8")
