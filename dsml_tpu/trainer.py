@@ -44,6 +44,46 @@ class TrainConfig(Config):
     checkpoint_dir: str = field("", help="Orbax checkpoint directory ('' = no checkpointing)")
     save_every: int = field(1, help="checkpoint every N epochs")
     resume: bool = field(False, help="resume from the latest checkpoint in checkpoint_dir")
+    progress: bool = field(False, help="draw per-epoch train/eval progress bars on stderr (reference client UX)")
+
+
+class _ProgressBar:
+    """Minimal in-place stderr bar matching the reference client's
+    schollz/progressbar UX (per-epoch training bar
+    ``DSML/client/client.go:584-590``, test bar ``client.go:467-473``).
+    Off unless ``TrainConfig.progress`` — a redraw per batch is host-side
+    noise the compiled step loop doesn't need by default."""
+
+    def __init__(self, total: int, label: str, enabled: bool, width: int = 30):
+        import sys
+
+        self.total = max(total, 1)
+        self.label = label
+        self.enabled = enabled  # draws even when piped, like the reference's bar
+        self.width = width
+        self.n = 0
+        self._last_cells = -1
+        self._err = sys.stderr
+
+    def update(self, k: int = 1) -> None:
+        if not self.enabled:
+            return
+        self.n = min(self.n + k, self.total)
+        cells = self.n * self.width // self.total
+        if cells == self._last_cells and self.n != self.total:
+            return  # redraw only when the bar visibly moves
+        self._last_cells = cells
+        pct = 100 * self.n // self.total
+        bar = "█" * cells + " " * (self.width - cells)
+        self._err.write(f"\r{self.label} {pct:3d}% |{bar}| ({self.n}/{self.total})")
+        self._err.flush()
+
+    def close(self) -> None:
+        if self.enabled:
+            if self.n < self.total:
+                self.update(self.total - self.n)
+            self._err.write("\n")
+            self._err.flush()
 
 
 def _make_optimizer(cfg: TrainConfig, steps_per_epoch: int) -> optax.GradientTransformation:
@@ -128,11 +168,15 @@ class Trainer:
             batches = prefetch_batches(
                 shard_batches(data.train_x, data.train_y, cfg.batch_size, seed=cfg.seed + epoch)
             )
+            bar = _ProgressBar(steps_per_epoch, f"Epoch {epoch}/{cfg.epochs}",
+                               cfg.progress)
             for x, y in batches:
                 params, opt_state, loss = self._step_fn(params, opt_state, x, y)
                 losses.append(loss)
+                bar.update()
                 if len(losses) % sync_every == 0:
                     losses[-1].block_until_ready()
+            bar.close()
             em = EpochMetrics()
             for loss in losses:
                 em.update(float(loss), 0, cfg.batch_size)
@@ -154,7 +198,10 @@ class Trainer:
             if last_epoch >= start_epoch and last_epoch % max(cfg.save_every, 1) != 0:
                 ckpt.save(last_epoch, params, opt_state, meta={"epoch": last_epoch})
             ckpt.close()
-        test_acc = self.evaluate(params, data.test_x, data.test_y)
+        test_acc = self.evaluate(
+            params, data.test_x, data.test_y,
+            progress_label="Testing" if cfg.progress else None,
+        )
         wall = time.monotonic() - t0
         epochs_run = max(cfg.epochs - start_epoch + 1, 0)  # resume skips earlier epochs
         samples = epochs_run * steps_per_epoch * cfg.batch_size
@@ -164,11 +211,14 @@ class Trainer:
         )
         return params, history, test_acc
 
-    def evaluate(self, params, x: np.ndarray, y: np.ndarray, batch_size: int = 2048) -> float:
+    def evaluate(self, params, x: np.ndarray, y: np.ndarray, batch_size: int = 2048,
+                 progress_label: str | None = None) -> float:
         n_dp = max(self.mesh.shape.get("dp", 1), 1)
         n = x.shape[0]
         usable = n - (n % n_dp)  # each eval batch must split evenly over dp
         bs = max(batch_size - batch_size % n_dp, n_dp)
+        bar = _ProgressBar((usable + bs - 1) // bs, progress_label or "Testing",
+                           progress_label is not None)
         correct = 0
         for start in range(0, usable, bs):
             xb, yb = x[start : start + bs], y[start : start + bs]
@@ -176,4 +226,6 @@ class Trainer:
                 cut = xb.shape[0] - xb.shape[0] % n_dp
                 xb, yb = xb[:cut], yb[:cut]
             correct += int(self._eval_fn(params, xb, yb))
+            bar.update()
+        bar.close()
         return correct / max(usable, 1)

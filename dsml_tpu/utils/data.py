@@ -300,6 +300,13 @@ _PROSE_MODULES = (
     "urllib.parse", "urllib.request", "uuid", "warnings", "wave", "weakref",
     "xml.dom", "xml.etree.ElementTree", "zipfile", "zoneinfo",
     "numpy", "numpy.linalg", "numpy.fft", "numpy.random",
+    # ML-library docstrings (all baked into this image, BSD/Apache): they
+    # roughly double the prose pool, which the 16k-vocab BPE row needs —
+    # 1.6 MB of text cannot support 16k merges (most pairs fall under
+    # min_pair_freq and the trainer early-stops far short)
+    "jax", "jax.numpy", "jax.scipy.linalg", "flax.linen", "optax",
+    "einops", "chex", "torch", "torch.nn", "torch.optim", "torch.utils.data",
+    "transformers",
 )
 
 
@@ -342,13 +349,24 @@ def build_prose_corpus(max_bytes: int = 4_000_000) -> str:
             except OSError:
                 continue
 
+    # import EVERYTHING first, then traverse: the ML libraries lazily
+    # import each other's internals (flax/transformers pull jax submodules
+    # in), which ADDS attributes to modules earlier in this list — a
+    # traversal interleaved with imports would see different membership on
+    # a second call and break the determinism promised below
+    mods = {}
+    for modname in _PROSE_MODULES:
+        try:
+            mods[modname] = importlib.import_module(modname)
+        except Exception:  # noqa: BLE001 — any unimportable module is skipped
+            continue
+
     total = lambda: sum(len(p) for p in parts)  # noqa: E731
     for modname in _PROSE_MODULES:
         if total() >= max_bytes:
             break
-        try:
-            mod = importlib.import_module(modname)
-        except Exception:  # noqa: BLE001 — any unimportable module is skipped
+        mod = mods.get(modname)
+        if mod is None:
             continue
         add(inspect.getdoc(mod))
         for _, obj in sorted(vars(mod).items()):
@@ -390,6 +408,6 @@ def load_text_corpus(
     text = build_prose_corpus(max_bytes)
     return (
         np.frombuffer(text.encode("utf-8"), np.uint8).copy(),
-        "repo markdown docs + Python stdlib/numpy docstrings (real English "
-        "prose, technical register; byte-level tokens)",
+        "repo markdown docs + Python stdlib/numpy/ML-library docstrings "
+        "(real English prose, technical register; byte-level tokens)",
     )

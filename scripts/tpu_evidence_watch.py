@@ -32,15 +32,15 @@ SECTIONS = [
     ("gpt2_decode", 1200),  # plain + wq8 + kv8 + kv4 variants, 2 compiles each
     ("allreduce", 600),   # incl. the e2e wire-path row (VERDICT r3 item 7)
     ("gpt2_seq8k", 900),
-    ("mnist", 600),
+    ("mnist", 900),  # MLP ladder + the 12-epoch CNN accuracy leg
     ("gpt2_medium", 1200),  # large compile (~130 s)
-    ("realtext", 1200),
+    ("realtext", 1800),  # byte + BPE-2k + BPE-16k variants, 3 model trains
     ("serving", 1800),  # many programs: chunk/decode/static/spec/llama+verify
     ("gpt2_large", 1500),  # 774M scale row (~200 s compile)
     ("gpt2_xl", 1800),  # 1.5B adafactor+remat row; heaviest compile (~350 s)
     ("llama1b", 1500),  # second-family 1.1B scale row
     ("gpt2_seq16k", 900),  # length stretch rows LAST — lowest marginal signal
-    ("gpt2_seq32k", 900),
+    ("gpt2_seq32k", 1500),  # may compile twice: selective-remat attempt + fallback
 ]
 
 PROBE = (
