@@ -531,6 +531,7 @@ def bench_gpt2_realtext() -> dict:
             params, opt_state, loss = train_step(params, opt_state, x, y)
             losses.append(float(loss))
             xb, yb = x, y
+            _bump_progress()  # a 300-step leg must not look like a hang
         # steady-state step seconds — what the vocab size costs in
         # embed/unembed throughput at this trunk (d_model x vocab matmuls).
         # DIFFERENCED (k-chained dispatches, one scalar sync, t8−t1) so the
@@ -549,8 +550,10 @@ def bench_gpt2_realtext() -> dict:
         # not move the step-cost ratio (same policy as the serving drains)
         pairs = [(chain(8), chain(1)) for _ in range(3)]
         diffs = [(t8 - t1) / 7 for t8, t1 in pairs if t8 - t1 > 1e-3]
-        step_s = (float(np.median(diffs)) if diffs
-                  else float(np.median([t8 / 8 for t8, _ in pairs])))
+        if diffs:
+            step_s, step_timing = float(np.median(diffs)), "differenced"
+        else:  # jitter swamped every diff; absolute retains ~RTT/8 overhead
+            step_s, step_timing = float(np.median([t8 / 8 for t8, _ in pairs])), "absolute"
         ev = None
         n_targets = 0
         if eval_toks is not None:
@@ -570,16 +573,17 @@ def bench_gpt2_realtext() -> dict:
             if ev_losses:
                 ev = float(np.mean(ev_losses))
         return (float(np.mean(losses[:10])), float(np.mean(losses[-10:])),
-                ev, n_targets, step_s)
+                ev, n_targets, step_s, step_timing)
 
     train_b, eval_b = carve_lm_eval_split(tokens.astype(np.int32), seq, batch)
-    first, final, ev, _, byte_step_s = train_eval(train_b, eval_b, 256)
+    first, final, ev, _, byte_step_s, byte_step_timing = train_eval(train_b, eval_b, 256)
     out = {
         "gpt2_realtext_first_loss": round(first, 4),
         "gpt2_realtext_final_loss": round(final, 4),
         "gpt2_realtext_steps": steps,
         "gpt2_realtext_tokens_per_step": batch * seq,
         "gpt2_realtext_step_ms": round(byte_step_s * 1e3, 1),
+        "gpt2_realtext_step_timing": byte_step_timing,
         "gpt2_realtext_corpus_bytes": int(len(tokens)),
         "gpt2_realtext_model": f"byte-GPT2 L{n_layer} d{d_model} seq{seq} {dtype}",
         "gpt2_realtext_provenance": provenance,
@@ -610,10 +614,11 @@ def bench_gpt2_realtext() -> dict:
         train_text = bytes(train_b.astype(np.uint8)).decode("utf-8", errors="replace")
         eval_text = bytes(eval_b.astype(np.uint8)).decode("utf-8", errors="replace")
         tok = BPETokenizer.train(train_text, vocab_size=vocab_target)
+        _bump_progress()  # a 16k-merge train costs ~a minute of silence
         train_ids = tok.encode_array(train_text)
         eval_ids = tok.encode_array(eval_text)
         bytes_per_token = len(train_b) / max(len(train_ids), 1)
-        bfirst, bfinal, bev, n_targets, bpe_step_s = train_eval(
+        bfirst, bfinal, bev, n_targets, bpe_step_s, bpe_step_timing = train_eval(
             train_ids, eval_ids, padded_vocab(tok.vocab_size)
         )
         out.update({
@@ -625,9 +630,16 @@ def bench_gpt2_realtext() -> dict:
             # the embed/unembed throughput cost of the larger vocab at this
             # trunk (matched steps/batch/seq — the honest price of bpb)
             f"{prefix}_step_ms": round(bpe_step_s * 1e3, 1),
+            f"{prefix}_step_timing": bpe_step_timing,
             f"{prefix}_step_cost_vs_byte": round(
                 bpe_step_s / max(byte_step_s, 1e-9), 2),
         })
+        if bpe_step_timing != byte_step_timing:
+            out[f"{prefix}_step_cost_note"] = (
+                f"timing modes differ (byte {byte_step_timing}, this variant "
+                f"{bpe_step_timing}) — the absolute side retains ~1/8 of a "
+                "dispatch round trip, so the ratio is only indicative"
+            )
         if bev is not None and n_targets:
             # exact per-byte normalization: total nats over the eval
             # windows' target tokens divided by those tokens' OWN byte

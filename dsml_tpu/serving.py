@@ -394,11 +394,24 @@ class ContinuousBatcher:
             make_decode_until(adaptive_quantum) if adaptive_quantum else None
         )
 
-        def prefill_fn(p, toks, last):
-            return model.prefill(p, toks, tp_axis, last_index=last)
-
         def prefill_chunk_fn(p, c, toks, start, last):
             return model.prefill_chunk(p, c, toks, start, tp_axis, last_index=last)
+
+        # FUSED admission programs: prefill + scatter-into-slot in ONE
+        # dispatch (slot is traced, so one compile serves every slot).
+        # Admission cost halves: each whole-prompt admit and each chunked
+        # admission's final chunk save a host round trip vs the separate
+        # _insert call (which remains for the prefix-cache copy path, where
+        # the stored master rows must NOT be donated)
+        def prefill_insert_fn(p, cache, toks, last, slot):
+            logits, c1 = model.prefill(p, toks, tp_axis, last_index=last)
+            return logits, ContinuousBatcher._insert_fn(cache, c1, slot)
+
+        def prefill_chunk_insert_fn(p, cache, c1, toks, start, last, slot):
+            logits, c1 = model.prefill_chunk(
+                p, c1, toks, start, tp_axis, last_index=last
+            )
+            return logits, ContinuousBatcher._insert_fn(cache, c1, slot)
 
         def verify_fn(p, c, toks, pos):  # toks [B, W], pos [B] per-slot depth
             return model.verify_step(p, c, toks, pos, tp_axis)
@@ -419,11 +432,12 @@ class ContinuousBatcher:
                 jax.jit(decode_adaptive, donate_argnums=(1,))
                 if decode_adaptive else None
             )
-            # one prefill compile per bucket length (static last_index
-            # would recompile per prompt length — keep it traced)
-            self._prefill = jax.jit(prefill_fn)
             # ONE compile serves every chunk: start/last_index stay traced
             self._prefill_chunk = jax.jit(prefill_chunk_fn, donate_argnums=(1,))
+            self._prefill_insert = jax.jit(prefill_insert_fn, donate_argnums=(1,))
+            self._prefill_chunk_insert = jax.jit(
+                prefill_chunk_insert_fn, donate_argnums=(1, 2)
+            )
             self._verify = jax.jit(verify_fn, donate_argnums=(1,))
             self._fresh_cache1 = lambda: model.init_cache(1)
         else:
@@ -475,14 +489,6 @@ class ContinuousBatcher:
                 )
                 if decode_adaptive else None
             )
-            self._prefill = jax.jit(
-                jax.shard_map(
-                    prefill_fn, mesh=mesh,
-                    in_specs=(pspecs, P(), P()),
-                    out_specs=(P(), cache_spec),
-                    check_vma=False,
-                )
-            )
             self._prefill_chunk = jax.jit(
                 jax.shard_map(
                     prefill_chunk_fn, mesh=mesh,
@@ -491,6 +497,24 @@ class ContinuousBatcher:
                     check_vma=False,
                 ),
                 donate_argnums=(1,),
+            )
+            self._prefill_insert = jax.jit(
+                jax.shard_map(
+                    prefill_insert_fn, mesh=mesh,
+                    in_specs=(pspecs, cache_spec, P(), P(), P()),
+                    out_specs=(P(), cache_spec),
+                    check_vma=False,
+                ),
+                donate_argnums=(1,),
+            )
+            self._prefill_chunk_insert = jax.jit(
+                jax.shard_map(
+                    prefill_chunk_insert_fn, mesh=mesh,
+                    in_specs=(pspecs, cache_spec, cache_spec, P(), P(), P(), P()),
+                    out_specs=(P(), cache_spec),
+                    check_vma=False,
+                ),
+                donate_argnums=(1, 2),
             )
             self._verify = jax.jit(
                 jax.shard_map(
@@ -671,12 +695,12 @@ class ContinuousBatcher:
         bucket = _bucket(L, self.prompt_buckets)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :L] = req.prompt
-        logits, cache1 = self._prefill(
-            self.params, jnp.asarray(padded), jnp.int32(L - 1)
+        # fused prefill+insert: one dispatch per admission
+        logits, self._cache = self._prefill_insert(
+            self.params, self._cache, jnp.asarray(padded), jnp.int32(L - 1),
+            jnp.int32(slot),
         )
         self.n_prefill_dispatches += 1
-        self.n_insert_dispatches += 1
-        self._cache = self._insert(self._cache, cache1, slot)
         self._finish_admission(req, slot, logits[0], emitted)
 
     def _admit(self) -> dict[int, list]:
@@ -702,17 +726,21 @@ class ContinuousBatcher:
         padded[0, : end - start] = req.prompt[start:end]
         is_last = end >= L
         last_local = (L - 1) - start if is_last else c - 1
-        logits, cache1 = self._prefill_chunk(
-            self.params, cache1, jnp.asarray(padded),
-            jnp.int32(start), jnp.int32(last_local),
-        )
-        self.n_prefill_dispatches += 1
         if not is_last:
+            logits, cache1 = self._prefill_chunk(
+                self.params, cache1, jnp.asarray(padded),
+                jnp.int32(start), jnp.int32(last_local),
+            )
+            self.n_prefill_dispatches += 1
             self._pending = (req, slot, cache1, start + c)
             return False
+        # final chunk: fused chunk-prefill + insert — one dispatch
+        logits, self._cache = self._prefill_chunk_insert(
+            self.params, self._cache, cache1, jnp.asarray(padded),
+            jnp.int32(start), jnp.int32(last_local), jnp.int32(slot),
+        )
+        self.n_prefill_dispatches += 1
         self._pending = None
-        self.n_insert_dispatches += 1
-        self._cache = self._insert(self._cache, cache1, slot)
         self._finish_admission(req, slot, logits[0], emitted)
         return True
 

@@ -36,6 +36,9 @@ class ServeConfig(Config):
     n_slots: int = field(4, help="decode slots (concurrent requests)")
     quantum: int = field(1, help="tokens decoded per scheduler tick (one jitted "
                          "scan; amortizes the per-tick host round trip)")
+    adaptive: int = field(0, help="adaptive early-exit tick budget: one device "
+                          "dispatch decodes until any slot finishes (or this "
+                          "many steps); 0 = off")
     turbo: int = field(0, help="turbo factor: compile a second decode program "
                        "with quantum*turbo tokens/tick and escalate to it in "
                        "steady-state decode (0 = off)")
@@ -77,6 +80,7 @@ def main() -> None:
         model, params, n_slots=cfg.n_slots, temperature=cfg.temperature,
         seed=cfg.seed, prompt_buckets=(16, 32, 64), decode_quantum=cfg.quantum,
         turbo_factor=cfg.turbo, prefill_chunk=cfg.prefill_chunk,
+        adaptive_quantum=cfg.adaptive,
     )
     # warmup pass: compile every bucket's prefill + the decode program so
     # the timed pass measures steady-state serving, not compilation
@@ -84,7 +88,9 @@ def main() -> None:
         srv.submit(p, int(n))
     srv.run()
     rids = [srv.submit(p, int(n)) for p, n in zip(prompts, budgets)]
-    plain0, turbo0 = srv.n_plain_ticks, srv.n_turbo_ticks  # warmup's dispatches
+    # warmup's dispatches
+    plain0, turbo0, adapt0 = (srv.n_plain_ticks, srv.n_turbo_ticks,
+                              srv.n_adaptive_ticks)
     t0 = time.monotonic()
     steps = 0
     useful_ticks = 0  # decode-lane ticks that produced a wanted token
@@ -95,6 +101,7 @@ def main() -> None:
     srv.collect()
     n_plain = srv.n_plain_ticks - plain0
     n_turbo = srv.n_turbo_ticks - turbo0
+    n_adapt = srv.n_adaptive_ticks - adapt0
     # decode-lane capacity actually dispatched this pass (turbo ticks carry
     # turbo x the base quantum). useful_ticks counts every emitted token
     # including each request's prefill-sampled FIRST token, which consumes
@@ -132,13 +139,23 @@ def main() -> None:
         static_useful += sum(int(budgets[g]) - 1 for g in group)
         static_ticks += (n_max - 1) * cfg.n_slots
 
-    util = useful_ticks / max(lane_capacity, 1)
     static_util = static_useful / max(static_ticks, 1)
-    log.info(
-        "continuous: %.2fs (%d scheduler steps, lane utilization %.0f%%, "
-        "%d plain / %d turbo decode dispatches)",
-        cont_s, steps, 100 * util, n_plain, n_turbo,
-    )
+    if n_adapt:
+        # adaptive ticks decode a data-dependent number of steps, so fixed
+        # lane-capacity accounting doesn't apply — the dispatch count IS
+        # the story (early exit means no tick over-decodes a retired slot)
+        log.info(
+            "continuous: %.2fs (%d scheduler steps, %d adaptive early-exit "
+            "decode dispatches, %d plain)",
+            cont_s, steps, n_adapt, n_plain,
+        )
+    else:
+        util = useful_ticks / max(lane_capacity, 1)
+        log.info(
+            "continuous: %.2fs (%d scheduler steps, lane utilization %.0f%%, "
+            "%d plain / %d turbo decode dispatches)",
+            cont_s, steps, 100 * util, n_plain, n_turbo,
+        )
     log.info(
         "static    : %.2fs (lane utilization %.0f%% — idle lanes wait for the "
         "group's longest request)", static_s, 100 * static_util,
@@ -152,8 +169,9 @@ def main() -> None:
         "compiled scan (zero host round trips), so it wins offline wall-clock "
         "at toy scale; continuous batching wins lane UTILIZATION (above), "
         "online arrival (it starts serving immediately), and tail latency — "
-        "raise --quantum to amortize the per-tick round trip (the dominant "
-        "cost over a tunneled TPU)"
+        "use --adaptive K (early-exit device loop) or raise --quantum to "
+        "amortize the per-tick round trip (the dominant cost over a "
+        "tunneled TPU)"
     )
 
 
