@@ -89,8 +89,45 @@ _RUN: dict = {
 }
 
 
+# Exit code for a watchdog-aborted run: the one JSON line HAS been printed
+# and flushed, but the run did not complete normally — drivers keying on the
+# return code must not read a watchdog abort as a clean success (ADVICE r5:
+# os._exit(0) made them indistinguishable). Distinct from run_section's 4
+# (unknown section) and from ordinary nonzero crashes (no JSON line at all).
+WATCHDOG_EXIT_CODE = 3
+
+
 def _bump_progress() -> None:
     _RUN["last_progress"] = time.monotonic()
+
+
+class _compile_heartbeat:
+    """Context manager bumping the watchdog progress clock during a long
+    compile (the one legitimate silent window: no _bump_progress is possible
+    mid-compile, and a slow gpt2_xl tunnel compile can outlast BENCH_STALL_S
+    — ADVICE r5). BOUNDED: beats stop after ``BENCH_COMPILE_HEARTBEAT_S``
+    (default 900 s), so a genuinely hung compile still trips the stall
+    trigger eventually instead of being heartbeated forever."""
+
+    def __enter__(self):
+        self._stop = threading.Event()
+        max_s = _env_float("BENCH_COMPILE_HEARTBEAT_S", 900.0)
+
+        def beat():
+            t0 = time.monotonic()
+            _bump_progress()  # pre-compile bump: reset the stall clock NOW
+            while not self._stop.wait(30.0):
+                if time.monotonic() - t0 > max_s:
+                    return
+                _bump_progress()
+
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        return False
 
 
 def _claim_emit() -> bool:
@@ -149,7 +186,8 @@ def _p50_wall(fn, reps: int = 5) -> float:
     tweaks can't drift between rows."""
     import numpy as np
 
-    fn()
+    with _compile_heartbeat():  # warmup may hold a long remote compile
+        fn()
     _bump_progress()  # warmup/compile done — tell the watchdog we're alive
     ts = []
     for _ in range(reps):
@@ -348,11 +386,14 @@ def _timed_train_steps(model, optimizer, params, opt_state, x, y,
 
     run1, runk = make_run(1), make_run(1 + k_extra)
     t0 = time.monotonic()
-    state1 = run1(params, opt_state)
-    float(state1[2])  # scalar fetch = the only real sync on the tunneled chip
-    _bump_progress()  # compile done — the longest legitimate silent window
-    statek = runk(*state1[:2])
-    float(statek[2])
+    # heartbeat through BOTH compiles: the gpt2_xl remote compile alone runs
+    # ~350 s and a slow tunnel can push it past the stall trigger
+    with _compile_heartbeat():
+        state1 = run1(params, opt_state)
+        float(state1[2])  # scalar fetch = the only real sync on the tunneled chip
+        _bump_progress()  # first compile done
+        statek = runk(*state1[:2])
+        float(statek[2])
     compile_s = time.monotonic() - t0
     _bump_progress()
 
@@ -727,9 +768,19 @@ def bench_serving() -> dict:
     srv.collect()
     srv.reset_latency_stats()  # warmup requests must not skew the percentiles
 
-    # timed: streaming arrivals — a third of the requests queue up front
-    # (a burst), the rest arrive 2 per tick (Poisson-ish steady stream)
+    # timed: streaming arrivals — a third of the requests queue up front (a
+    # burst), the rest arrive on ONE fixed wall-clock timestamp list shared
+    # by every scheduler variant (ADVICE r5: the old 2-per-tick stream let
+    # each scheduler's own step latency reshape its arrival process, so the
+    # adaptive-vs-plain rows compared slightly mismatched workloads). The
+    # cadence approximates the old stream's rate at the plain scheduler's
+    # tick time; what matters is that it is IDENTICAL across variants.
     arrivals = list(zip(prompts, budgets))
+    burst = n_requests // 3
+    arrival_dt = 0.08 if on_tpu else 0.02  # ~half a plain tick per arrival
+    arrival_times = [0.0] * burst + [
+        (i + 1) * arrival_dt for i in range(n_requests - burst)
+    ]
 
     def n_dispatches(batcher):
         # every host→device round trip the scheduler pays: decode ticks,
@@ -741,13 +792,20 @@ def bench_serving() -> dict:
     def run_streaming(batcher):
         d0 = n_dispatches(batcher)
         t0 = time.monotonic()
-        for p, n in arrivals[: n_requests // 3]:
-            batcher.submit(p, n)
-        i = n_requests // 3
+        i = 0
         while batcher.n_queued or batcher.n_active or batcher.n_pending or i < n_requests:
-            for p, n in arrivals[i : i + 2]:
+            now = time.monotonic() - t0
+            while i < n_requests and arrival_times[i] <= now:
+                p, n = arrivals[i]
                 batcher.submit(p, n)
-            i += 2
+                i += 1
+            if i < n_requests and not (
+                batcher.n_queued or batcher.n_active or batcher.n_pending
+            ):
+                # drained before the next arrival is due: wait for it
+                # instead of spinning empty ticks
+                time.sleep(max(arrival_times[i] - (time.monotonic() - t0), 0.0))
+                continue
             batcher.step()
         out = batcher.collect()
         wall = time.monotonic() - t0
@@ -1267,6 +1325,111 @@ def _virtual8_main() -> None:
     if wire_err:
         out["wire_e2e_error"] = wire_err
     print(json.dumps(out))
+
+
+def _bucket_sweep_main() -> None:
+    """Subprocess entry: gradient-bucketing sweep on the 8-device virtual
+    CPU mesh — per-sync wall time for an 8 MiB synthetic gradient pytree
+    across bucket sizes {1 buffer, 1, 4, 16 MiB} × {ring, q8}. The same
+    differenced-repeats methodology as ``_differenced_ring_p50`` (chain R
+    syncs in ONE program, difference R_hi vs 1) so per-dispatch overhead
+    cancels. Relative signal only (CPU collectives, not ICI) — what it
+    decides is the DSML_BUCKET_MB default's order of magnitude
+    (docs/TUNING.md records the choice)."""
+    from dsml_tpu.utils.platform import configure_platform
+
+    configure_platform("cpu", 8)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from dsml_tpu.ops.collectives import ReduceOp
+    from dsml_tpu.parallel.bucketing import bucketed_all_reduce, plan_buckets
+    from dsml_tpu.parallel.mesh import build_mesh, MeshSpec
+
+    mesh = build_mesh(MeshSpec(dp=8), jax.devices()[:8])
+    # 32 × 256 KiB f32 leaves (8 MiB): big enough that 1/4 MiB targets give
+    # real bucket counts (8/2), small enough that the whole sweep lands in
+    # ~2-3 min on the CPU mesh (a 32 MiB tree measured 4× slower). The
+    # 16 MiB target exceeds the payload, so it coincides with 1buf here —
+    # kept anyway: at training scale (100M+ params) it does not.
+    rng = np.random.default_rng(0)
+    tree = {
+        f"w{i:02d}": jnp.asarray(rng.standard_normal(65_536), jnp.float32)
+        for i in range(32)
+    }
+    total_bytes = 32 * 65_536 * 4
+    r_hi, reps = 3, 3
+
+    def per_sync_ms(algorithm, bucket_mb):
+        def make(r):
+            def per_rank(t):
+                for _ in range(r):
+                    t = bucketed_all_reduce(t, "dp", ReduceOp.AVG, algorithm, bucket_mb)
+                return t
+
+            return jax.jit(jax.shard_map(
+                per_rank, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+            ))
+
+        def p50_of(r):
+            fn = make(r)
+            out = fn(tree)
+            float(out["w00"][0])  # compile + sync
+            ts = []
+            for _ in range(reps):
+                t0 = time.monotonic()
+                out = fn(out)
+                float(out["w00"][0])
+                ts.append((time.monotonic() - t0) * 1e3)
+            return float(np.percentile(ts, 50))
+
+        return max((p50_of(r_hi) - p50_of(1)) / (r_hi - 1), 0.0)
+
+    rows = {"payload_mb": round(total_bytes / (1 << 20), 1), "devices": 8}
+    for algorithm in ("ring", "q8"):
+        for bucket_mb, label in ((None, "1buf"), (1, "1mb"), (4, "4mb"), (16, "16mb")):
+            n_buckets = (
+                1 if bucket_mb is None
+                else plan_buckets(tree, bucket_mb).n_buckets
+            )
+            ms = per_sync_ms(algorithm, bucket_mb)
+            rows[f"{algorithm}_{label}_ms"] = round(ms, 3)
+            rows[f"{algorithm}_{label}_gbps"] = (
+                round(total_bytes / (ms * 1e-3) / 1e9, 3) if ms > 0 else None
+            )
+            rows[f"{algorithm}_{label}_buckets"] = n_buckets
+    print(json.dumps(rows))
+
+
+def bench_bucket_sweep() -> dict:
+    """Bucket-size sweep rows (virtual-8 mesh subprocess, same pattern as
+    :func:`bench_ring_virtual8`): per-sync ms + achieved payload bytes/s per
+    {bucket size} × {ring, q8} — the data the ``DSML_BUCKET_MB`` default is
+    chosen from. Labeled virtual-CPU: relative signal, not ICI."""
+    code = "import bench; bench._bucket_sweep_main()"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, cwd=".",
+            timeout=max(min(600.0, _budget_left()), 60.0),
+        )
+        if proc.returncode != 0 or not proc.stdout.strip():
+            return {
+                "bucket_sweep_error": (
+                    f"rc={proc.returncode}; stderr tail: {proc.stderr[-300:]}"
+                )
+            }
+        res = json.loads(proc.stdout.strip().splitlines()[-1])
+        out = {f"bucket_sweep_{k}": v for k, v in res.items()}
+        out["bucket_sweep_note"] = (
+            "8-device virtual CPU mesh: relative bucket-size signal for the "
+            "DSML_BUCKET_MB default, not ICI bandwidth"
+        )
+        return out
+    except Exception as e:  # never fail the bench on the secondary section
+        return {"bucket_sweep_error": repr(e)[:200]}
 
 
 def bench_ring_virtual8() -> dict:
@@ -1834,6 +1997,7 @@ _SECTIONS = {
     "allreduce": bench_ring_allreduce,
     "realtext": bench_gpt2_realtext,
     "serving": bench_serving,
+    "bucket_sweep": bench_bucket_sweep,  # virtual-8 sweep; no TPU rows
 }
 
 
@@ -1918,7 +2082,10 @@ def _watchdog_emit(reason: str) -> None:
             proc.kill()
         except OSError:
             pass
-    os._exit(0)
+    # nonzero documented code (see WATCHDOG_EXIT_CODE): the JSON line is
+    # flushed above, but a driver keying on the return code must see that
+    # this run was watchdog-aborted, not a clean success (ADVICE r5)
+    os._exit(WATCHDOG_EXIT_CODE)
 
 
 def _watchdog_loop() -> None:
@@ -1933,11 +2100,17 @@ def _watchdog_loop() -> None:
       final in-flight section's rows are sacrificed for the guaranteed line;
     - ``BENCH_WATCHDOG_S`` (~520 s) elapsed with NO measured row AND no
       recent section progress — the hung-device shape;
-    - no section progress for ``BENCH_STALL_S`` (~420 s; the longest
-      legitimate silent period is the XL remote compile at ~350 s) — the
-      tunnel-died-mid-run shape."""
+    - no section progress for ``BENCH_STALL_S`` (~600 s; the XL remote
+      compile runs ~350 s and additionally heartbeats through
+      ``_compile_heartbeat``, so only a genuinely dead tunnel — or a
+      compile hung past the heartbeat bound — goes silent this long) —
+      the tunnel-died-mid-run shape.
+
+    A watchdog abort exits with ``WATCHDOG_EXIT_CODE`` (3) after flushing
+    the JSON line — nonzero so drivers keying on the return code can tell
+    an aborted run from a clean one."""
     emergency_s = _env_float("BENCH_WATCHDOG_S", 520.0)
-    stall_s = _env_float("BENCH_STALL_S", 420.0)
+    stall_s = _env_float("BENCH_STALL_S", 600.0)
     grace_s = _env_float("BENCH_EMIT_GRACE_S", 45.0)
     while True:
         time.sleep(5.0)
@@ -2111,6 +2284,15 @@ def main() -> None:
         except Exception as e:
             errors["allreduce_virtual8"] = repr(e)[:300]
         _bump_progress()
+    # gradient-bucketing sweep (virtual-8 subprocess, every backend): the
+    # data the DSML_BUCKET_MB default is chosen from — cheap enough to ride
+    # along, budget-gated so it can never starve a measured TPU row
+    if not _skip_for_budget(extras, "bucket_sweep", 240):
+        try:
+            extras.update(bench_bucket_sweep())
+        except Exception as e:
+            errors["bucket_sweep"] = repr(e)[:300]
+        _bump_progress()
     _emit_final(extras, errors, no_tpu_signal, tpu_unreachable)
 
 
@@ -2240,6 +2422,10 @@ def _assemble_and_print(extras: dict, errors: dict, no_tpu_signal: bool,
             else "real device, 1 MB payload"
         ),
         "allreduce_virtual8": "8-device virtual CPU mesh — harness proof, not ICI",
+        "bucket_sweep": (
+            "8-device virtual CPU mesh — relative bucket-size signal for "
+            "the DSML_BUCKET_MB default, not ICI"
+        ),
     }
 
     if "gpt2_tokens_per_sec" in extras:

@@ -47,6 +47,8 @@ __all__ = [
     "all_reduce",
     "hierarchical_all_reduce",
     "reduce_scatter",
+    "flat_reduce_scatter",
+    "flat_all_gather",
     "all_gather",
     "all_to_all",
     "ppermute_ring",
@@ -308,19 +310,64 @@ def hierarchical_all_reduce(
     padded = -(-size // n_inner) * n_inner
     if padded != size:
         # pad with the op's identity so pad lanes can't perturb real lanes
-        if jnp.issubdtype(acc_dtype, jnp.floating):
-            hi, lo = jnp.inf, -jnp.inf
-        else:
-            info = jnp.iinfo(acc_dtype)
-            hi, lo = info.max, info.min
-        pad_val = {ReduceOp.PROD: 1, ReduceOp.MIN: hi, ReduceOp.MAX: lo}.get(op, 0)
-        flat = jnp.pad(flat, (0, padded - size), constant_values=pad_val)
+        flat = jnp.pad(
+            flat, (0, padded - size),
+            constant_values=_identity_pad_value(op, acc_dtype),
+        )
     shard = reduce_scatter(flat.reshape(n_inner, padded // n_inner), inner_axis, inner_op)
     shard = all_reduce(shard, outer_axis, outer_op, algorithm)
     out = lax.all_gather(shard, inner_axis, axis=0, tiled=False).reshape(-1)[:size]
     if op == ReduceOp.AVG:
         out = out / (n_inner * _axis_size(outer_axis))
     return out.reshape(orig_shape).astype(orig_dtype)
+
+
+def _identity_pad_value(op: ReduceOp, dtype) -> int | float:
+    """The reduction identity for ``op`` on ``dtype`` — what padding must be
+    filled with so pad lanes can't perturb real lanes when lanes from
+    different ranks combine."""
+    op = ReduceOp(op)
+    if op == ReduceOp.PROD:
+        return 1
+    if op in (ReduceOp.MIN, ReduceOp.MAX):
+        if jnp.issubdtype(dtype, jnp.floating):
+            hi, lo = jnp.inf, -jnp.inf
+        else:
+            info = jnp.iinfo(dtype)
+            hi, lo = info.max, info.min
+        return hi if op == ReduceOp.MIN else lo
+    return 0  # SUM / AVG
+
+
+def flat_reduce_scatter(
+    flat: jax.Array, axis_name: str, op: ReduceOp = ReduceOp.SUM
+) -> tuple[jax.Array, int]:
+    """Reduce-scatter a flat vector: rank i is left with contiguous segment
+    i of the reduction. Returns ``(shard, padded_size)`` where ``shard`` has
+    ``padded_size // n`` elements and ``padded_size`` is the vector length
+    rounded up to a multiple of the axis size (identity-padded, so pad lanes
+    are inert). The bucketed-gradient primitive: ZeRO-2 grad sync emits one
+    of these per bucket (``dsml_tpu.parallel.bucketing``), each an
+    independent collective XLA can overlap with remaining backward compute.
+    """
+    op = ReduceOp(op)
+    n = _axis_size(axis_name)
+    size = flat.shape[0]
+    padded = -(-size // n) * n
+    if padded != size:
+        flat = jnp.pad(
+            flat, (0, padded - size),
+            constant_values=_identity_pad_value(op, flat.dtype),
+        )
+    shard = reduce_scatter(flat.reshape(n, padded // n), axis_name, op)
+    return shard.reshape(-1), padded
+
+
+def flat_all_gather(shard: jax.Array, axis_name: str, size: int) -> jax.Array:
+    """Inverse of :func:`flat_reduce_scatter`'s layout: concatenate every
+    rank's flat segment and drop the padding, returning the first ``size``
+    elements."""
+    return lax.all_gather(shard, axis_name, axis=0, tiled=True).reshape(-1)[:size]
 
 
 def reduce_scatter(x: jax.Array, axis_name: str, op: ReduceOp = ReduceOp.SUM) -> jax.Array:

@@ -1,0 +1,227 @@
+"""Gradient bucketing: size-targeted flat buckets for overlap-friendly sync.
+
+The reference's AllReduceRing moved ONE monolithic buffer per sync, and the
+port kept that shape: ``parallel/dp.py`` raveled the whole gradient pytree
+into a single flat vector before one 2(n−1)-hop ring pass — serializing the
+entire backward against the entire exchange. Production data-parallel stacks
+(PyTorch DDP, the MLPerf TPU-pod entries — PAPERS.md "Scale MLPerf-0.6
+models on Google TPU-v3 Pods") instead partition gradients into
+size-targeted buckets and reduce each bucket as an INDEPENDENT collective,
+so the compiler's latency-hiding scheduler can overlap the exchange of
+already-finished gradients with the backward compute still producing the
+rest. For the quantized path the win is structural too: q8 quantizes per
+bucket, removing the full-vector ravel→quantize serialization.
+
+Mechanics:
+
+- :func:`plan_buckets` — greedy, order-preserving partition of a pytree's
+  leaves into buckets targeting ``bucket_size_mb`` MiB each. Buckets are
+  PER-DTYPE (a bucket concatenates raveled leaves, which requires one
+  dtype); a leaf larger than the target gets a bucket of its own — leaves
+  are never split, matching DDP practice (the unit of readiness in a
+  backward pass is the whole parameter's gradient).
+- :func:`flatten_buckets` / :func:`unflatten_buckets` — pytree ⇄ list of
+  flat per-bucket vectors, exact round trip (0-d leaves, mixed dtypes).
+- :func:`bucketed_all_reduce` — the sync: one collective per bucket
+  (``ring`` / ``ring2`` / ``naive`` / ``auto`` / ``xla`` via
+  ``ops.collectives.all_reduce``, or ``q8`` via
+  ``ops.quantization.compressed_all_reduce``), all emitted inside the same
+  jitted program. ``bucket_size_mb=None`` reproduces the pre-bucketing
+  single-buffer path bit-for-bit (same ``ravel_pytree`` + single collective
+  jaxpr) for A/B comparison.
+
+Default bucket size: 4 MiB, overridable via ``DSML_BUCKET_MB`` (the
+``bench.py`` bucket-size sweep on the virtual-8 mesh is what the default is
+chosen from — see docs/TUNING.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.flatten_util import ravel_pytree
+
+from dsml_tpu.ops.collectives import ReduceOp, all_reduce
+
+__all__ = [
+    "BucketPlan",
+    "default_bucket_mb",
+    "plan_buckets",
+    "flatten_buckets",
+    "unflatten_buckets",
+    "bucketed_all_reduce",
+]
+
+
+def default_bucket_mb() -> float:
+    """The bucket-size default: 4 MiB (chosen from the bench sweep — see
+    docs/TUNING.md), overridable via ``DSML_BUCKET_MB`` (malformed or
+    non-positive values fall back, same policy as bench.py's env knobs —
+    a size must be positive; "no bucketing" is ``bucket_size_mb=None`` at
+    the call site, not an env value)."""
+    try:
+        mb = float(os.environ.get("DSML_BUCKET_MB", 4.0))
+    except ValueError:
+        return 4.0
+    return mb if mb > 0 else 4.0
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    """Static partition of a pytree into flat buckets (all fields are
+    trace-time constants — shapes/dtypes/indices, never array data)."""
+
+    treedef: Any
+    shapes: tuple  # per-leaf shapes
+    dtypes: tuple  # per-leaf dtypes
+    buckets: tuple  # tuple of tuples of leaf indices, order-preserving
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+    def bucket_nbytes(self, b: int) -> int:
+        return sum(
+            _leaf_size(self.shapes[i]) * jnp.dtype(self.dtypes[i]).itemsize
+            for i in self.buckets[b]
+        )
+
+
+def _leaf_size(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def plan_buckets(tree, bucket_size_mb: float) -> BucketPlan:
+    """Partition ``tree``'s leaves into per-dtype buckets of ~``bucket_size_mb``
+    MiB. Greedy in leaf order: each dtype keeps one open bucket; a leaf
+    joins it if the bucket hasn't reached the target yet and the leaf alone
+    is under target, else a new bucket opens (so an over-target leaf always
+    sits in a bucket of its own). Leaves are never split."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = tuple(tuple(l.shape) for l in leaves)
+    dtypes = tuple(jnp.result_type(l) for l in leaves)
+    target = max(float(bucket_size_mb), 1e-6) * (1 << 20)
+    open_bucket: dict = {}  # dtype -> [list of leaf idx, bytes so far]
+    buckets: list = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        nbytes = _leaf_size(shape) * jnp.dtype(dtype).itemsize
+        key = str(dtype)
+        # an over-target leaf always opens its own bucket (it would blow an
+        # open bucket far past target; once placed, the >= target bucket
+        # closes itself via the same size check)
+        if nbytes < target and key in open_bucket and open_bucket[key][1] < target:
+            open_bucket[key][0].append(i)
+            open_bucket[key][1] += nbytes
+        else:
+            open_bucket[key] = [[i], nbytes]
+            buckets.append(open_bucket[key][0])
+    return BucketPlan(treedef, shapes, dtypes, tuple(tuple(b) for b in buckets))
+
+
+def flatten_buckets(tree, plan: BucketPlan) -> list:
+    """Flat 1-D vector per bucket: the bucket's leaves raveled and
+    concatenated in plan order (single-leaf buckets skip the concat)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    out = []
+    for idxs in plan.buckets:
+        if len(idxs) == 1:
+            out.append(leaves[idxs[0]].reshape(-1))
+        else:
+            out.append(jnp.concatenate([leaves[i].reshape(-1) for i in idxs]))
+    return out
+
+def unflatten_buckets(flat_buckets: Sequence, plan: BucketPlan):
+    """Exact inverse of :func:`flatten_buckets` (shapes/dtypes restored from
+    the plan, so a widened reduction dtype is cast back per leaf)."""
+    leaves: list = [None] * len(plan.shapes)
+    for idxs, flat in zip(plan.buckets, flat_buckets):
+        off = 0
+        for i in idxs:
+            n = _leaf_size(plan.shapes[i])
+            leaves[i] = (
+                lax.slice_in_dim(flat, off, off + n)
+                .reshape(plan.shapes[i])
+                .astype(plan.dtypes[i])
+            )
+            off += n
+    return jax.tree_util.tree_unflatten(plan.treedef, leaves)
+
+
+def _q8_bucket_seed(flat: jax.Array, bucket_index: int) -> jax.Array:
+    """Data-dependent dither seed, per bucket: the rounding pattern must
+    vary per step (slowly-moving coordinates would otherwise see the same
+    rounding direction every step — systematic bias) AND per bucket
+    (identical buckets must not share noise). Hashing the bucket's own
+    gradient bits decorrelates steps without threading a counter through
+    the step signature — the same trick parallel/dp.py used on the
+    monolithic vector, now applied per bucket with an index mix-in."""
+    as_f32 = flat if flat.dtype == jnp.float32 else flat.astype(jnp.float32)
+    return (
+        jnp.sum(lax.bitcast_convert_type(as_f32, jnp.int32), dtype=jnp.int32)
+        + jnp.int32(bucket_index * 7919)
+    )
+
+
+def bucketed_all_reduce(
+    tree,
+    axis_name: str,
+    op: ReduceOp = ReduceOp.AVG,
+    algorithm: str = "ring",
+    bucket_size_mb: float | None = None,
+) -> Any:
+    """All-reduce a pytree across ``axis_name`` as per-bucket collectives.
+
+    Call under ``shard_map``. ``algorithm`` is any
+    ``ops.collectives.all_reduce`` algorithm (``ring``/``ring2``/``naive``/
+    ``auto``/``xla``) or ``"q8"`` (blockwise-int8 compressed exchange,
+    SUM/AVG only — ``ops.quantization.compressed_all_reduce`` per bucket;
+    non-float buckets ride the ring uncompressed, since int8-quantizing
+    integer gradients would corrupt them).
+
+    ``bucket_size_mb=None`` is the pre-bucketing behavior: ONE flat buffer
+    via ``ravel_pytree`` and a single collective — bit-identical to the old
+    ``parallel/dp.py`` path (same jaxpr), kept for A/B measurement.
+    """
+    op = ReduceOp(op)
+    if algorithm == "q8" and op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError(f"q8 sync supports SUM/AVG, got {op!r}")
+    if bucket_size_mb is None:
+        flat, unravel = ravel_pytree(tree)
+        if algorithm == "q8":
+            from dsml_tpu.ops.quantization import compressed_all_reduce
+
+            seed = jnp.sum(
+                lax.bitcast_convert_type(flat, jnp.int32), dtype=jnp.int32
+            )
+            flat = compressed_all_reduce(
+                flat, axis_name, seed=seed, mean=(op == ReduceOp.AVG)
+            )
+        else:
+            flat = all_reduce(flat, axis_name, op, algorithm)
+        return unravel(flat)
+
+    plan = plan_buckets(tree, bucket_size_mb)
+    buckets = flatten_buckets(tree, plan)
+    reduced = []
+    for b, flat in enumerate(buckets):
+        if algorithm == "q8" and jnp.issubdtype(flat.dtype, jnp.floating):
+            from dsml_tpu.ops.quantization import compressed_all_reduce
+
+            out = compressed_all_reduce(
+                flat, axis_name, seed=_q8_bucket_seed(flat, b),
+                mean=(op == ReduceOp.AVG),
+            )
+        else:
+            out = all_reduce(
+                flat, axis_name, op, "ring" if algorithm == "q8" else algorithm
+            )
+        reduced.append(out)
+    return unflatten_buckets(reduced, plan)

@@ -8,15 +8,24 @@ batch is sharded across the ``dp`` mesh axis and gradients genuinely sync:
 - ``algorithm="xla"``  — batch carries ``P('dp')`` sharding into ``jit``; XLA
   propagates shardings and inserts the topology-optimal all-reduce for the
   mean-loss gradient. The default for training.
-- ``algorithm="ring"`` — explicit ``shard_map``: per-shard grads are raveled
-  into one flat vector and pushed around the 2(n-1)-step ``ppermute`` ring
-  (``dsml_tpu.ops.collectives.ring_all_reduce``) — the reference's
-  AllReduceRing schedule with honest semantics, usable end-to-end in
-  training (BASELINE.md config: "MNIST MLP, 4 TPU devices, ring AllReduce").
+- ``algorithm="ring"`` — explicit ``shard_map``: per-shard grads sync through
+  the 2(n-1)-step ``ppermute`` ring (``dsml_tpu.ops.collectives``) — the
+  reference's AllReduceRing schedule with honest semantics, usable
+  end-to-end in training (BASELINE.md config: "MNIST MLP, 4 TPU devices,
+  ring AllReduce"). ``"ring2"`` is the bidirectional variant, ``"auto"``
+  picks ring-vs-naive per payload.
 - ``algorithm="naive"`` — gather-everything baseline, for benchmarks.
 - ``algorithm="q8"``   — 8-bit compressed sync: per-rank gradients quantize
   to blockwise int8 with stochastic rounding before the exchange (≈4× fewer
   wire bytes; unbiased — ``dsml_tpu.ops.quantization``).
+
+Every explicit algorithm syncs through ``parallel.bucketing``: the gradient
+pytree partitions into ~``bucket_size_mb``-MiB buckets and each bucket's
+reduction is an INDEPENDENT collective inside the jitted step, so XLA's
+latency-hiding scheduler can overlap early buckets' exchange with the rest
+of the backward (and q8 quantizes per bucket instead of serializing one
+full-vector ravel→quantize). ``bucket_size_mb=None`` restores the old
+single-buffer sync bit-for-bit, for A/B measurement.
 """
 
 from __future__ import annotations
@@ -26,10 +35,10 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import optax
-from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dsml_tpu.ops.collectives import ReduceOp, all_reduce
+from dsml_tpu.ops.collectives import ReduceOp
+from dsml_tpu.parallel.bucketing import bucketed_all_reduce, default_bucket_mb
 
 __all__ = ["make_dp_train_step", "make_eval_step"]
 
@@ -41,15 +50,22 @@ def make_dp_train_step(
     algorithm: str = "xla",
     axis: str = "dp",
     donate: bool = True,
+    bucket_size_mb: float | None | str = "auto",
 ):
     """Build ``step(params, opt_state, x, y) -> (params, opt_state, loss)``.
 
     ``loss_fn(params, x, y)`` must return the mean loss over its (shard of
     the) batch. Params/opt-state are replicated; x/y enter sharded along
     ``axis``. The returned step is jitted over ``mesh``.
+
+    ``bucket_size_mb`` (explicit algorithms only): ``"auto"`` = the
+    ``DSML_BUCKET_MB`` env default (4 MiB — docs/TUNING.md), a number = that
+    many MiB per bucket, ``None`` = the pre-bucketing single-buffer sync.
     """
     repl = NamedSharding(mesh, P())
     batch_sh = NamedSharding(mesh, P(axis))
+    if bucket_size_mb == "auto":
+        bucket_size_mb = default_bucket_mb()
     # Loss-reactive transforms (adaptive_plateau) consume the loss via
     # ``value=``; the wrapper lets every optimizer accept the extra arg.
     optimizer = optax.with_extra_args_support(optimizer)
@@ -64,22 +80,10 @@ def make_dp_train_step(
         def compute_grads(params, x, y):
             def shard_fn(params, x, y):
                 loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
-                flat, unravel = ravel_pytree(grads)
-                if algorithm == "q8":
-                    from dsml_tpu.ops.quantization import compressed_all_reduce
-
-                    # data-dependent seed: the dither pattern must vary per
-                    # step or slowly-moving coordinates see the same rounding
-                    # direction every step (systematic bias). Hashing the
-                    # gradient bits decorrelates steps without threading a
-                    # counter through the step signature.
-                    seed = jnp.sum(
-                        jax.lax.bitcast_convert_type(flat, jnp.int32), dtype=jnp.int32
-                    )
-                    flat = compressed_all_reduce(flat, axis, seed=seed, mean=True)
-                else:
-                    flat = all_reduce(flat, axis, ReduceOp.AVG, algorithm)
-                return jax.lax.pmean(loss, axis), unravel(flat)
+                grads = bucketed_all_reduce(
+                    grads, axis, ReduceOp.AVG, algorithm, bucket_size_mb
+                )
+                return jax.lax.pmean(loss, axis), grads
 
             return jax.shard_map(
                 shard_fn,

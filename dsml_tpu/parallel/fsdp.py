@@ -1,22 +1,50 @@
 """FSDP / ZeRO-style parameter sharding over the ``fsdp`` mesh axis.
 
 Memory-efficiency capability (reference: literature only — SURVEY.md §2.4
-"7. Memory/"). TPU-idiomatic formulation: instead of hand-rolling gather/
-scatter, each parameter leaf is *annotated* as sharded on its largest
-divisible axis over ``fsdp``; XLA's SPMD partitioner then materializes
-weights via all-gather just-in-time per layer and reduce-scatters gradients
-— the ZeRO-3 communication pattern, derived by the compiler from sharding
-annotations alone. Optimizer state inherits the same sharding (ZeRO-1/2 come
-along for free: moments live sharded).
+"7. Memory/"). Two formulations:
+
+- **Annotation-driven ZeRO-3** (:func:`make_fsdp_train_step`): each param
+  leaf is *annotated* as sharded on its largest divisible axis over
+  ``fsdp``; XLA's SPMD partitioner materializes weights via all-gather
+  just-in-time per layer and reduce-scatters gradients — the ZeRO-3
+  communication pattern derived by the compiler from sharding annotations
+  alone. Optimizer state inherits the sharding (ZeRO-1/2 for free).
+- **Explicit bucketed ZeRO-2** (:func:`make_zero2_train_step`): params stay
+  replicated; gradients partition into ~``bucket_size_mb``-MiB buckets
+  (``parallel.bucketing``) and each bucket REDUCE-SCATTERS as an
+  independent collective inside the jitted step — so XLA can overlap early
+  buckets' exchange with the rest of the backward — leaving each rank one
+  contiguous flat shard (1/n) of the gradient space. The optimizer runs on
+  that shard only (state is n×-sharded — ZeRO-2's memory shape), and the
+  updated shards all-gather back per bucket. This is the explicit
+  reduce-scatter data path the reference's ring schedule implied but never
+  delivered, with the bucket granularity production DP stacks use.
 """
 
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import optax
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["fsdp_shardings", "shard_params_fsdp", "make_fsdp_train_step"]
+from dsml_tpu.ops.collectives import ReduceOp, flat_all_gather, flat_reduce_scatter
+from dsml_tpu.parallel.bucketing import (
+    _leaf_size,
+    default_bucket_mb,
+    flatten_buckets,
+    plan_buckets,
+    unflatten_buckets,
+)
+
+__all__ = [
+    "fsdp_shardings",
+    "shard_params_fsdp",
+    "make_fsdp_train_step",
+    "make_zero2_train_step",
+    "init_zero2",
+]
 
 
 def fsdp_shardings(params, mesh: Mesh, axis: str = "fsdp"):
@@ -71,3 +99,145 @@ def init_fsdp(model, optimizer, mesh: Mesh, seed: int = 0, axis: str = "fsdp"):
     params = shard_params_fsdp(model.init(seed), mesh, axis)
     opt_state = jax.jit(optimizer.init)(params)
     return params, opt_state
+
+
+# ---------------------------------------------------------------------------
+# Explicit bucketed ZeRO-2 (reduce-scatter grads, sharded optimizer state)
+# ---------------------------------------------------------------------------
+
+
+def _local_shards(buckets, axis: str, n: int):
+    """Each rank's contiguous segment of every (identity-padded) bucket."""
+    rank = lax.axis_index(axis)
+    out = []
+    for flat in buckets:
+        padded = -(-flat.shape[0] // n) * n
+        if padded != flat.shape[0]:
+            flat = jnp.pad(flat, (0, padded - flat.shape[0]))
+        seg = padded // n
+        out.append(lax.dynamic_slice_in_dim(flat, rank * seg, seg))
+    return out
+
+
+def _opt_specs(opt_state, axis: str):
+    """shard_map specs for ZeRO-2 optimizer state: array leaves (per-rank
+    moment shards) ride sharded over ``axis``; scalar leaves (step counts —
+    identical on every rank) stay replicated. Sound for ELEMENTWISE
+    optimizers (sgd/adam/adamw/...): their state mirrors the param shards
+    leaf-for-leaf. Shape-aware optimizers (adafactor's factored moments)
+    need the pytree-shaped :func:`make_fsdp_train_step` path instead."""
+    return jax.tree.map(lambda l: P(axis) if jnp.ndim(l) >= 1 else P(), opt_state)
+
+
+def init_zero2(
+    model,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    seed: int = 0,
+    axis: str = "fsdp",
+    bucket_size_mb: float | None | str = "auto",
+):
+    """(params, opt_state) for :func:`make_zero2_train_step`: params
+    replicated on the mesh, optimizer state initialized over each rank's
+    flat bucket shards and left sharded over ``axis`` (the ZeRO-2 n× state
+    saving). ``bucket_size_mb`` must match the step's."""
+    if bucket_size_mb == "auto":
+        bucket_size_mb = default_bucket_mb()
+    n = mesh.shape[axis]
+    optimizer = optax.with_extra_args_support(optimizer)
+    params = jax.device_put(model.init(seed), NamedSharding(mesh, P()))
+    # None → one bucket per dtype (must match make_zero2_train_step's plan)
+    plan = plan_buckets(
+        params, bucket_size_mb if bucket_size_mb is not None else float("inf")
+    )
+
+    def shard_structs():
+        out = []
+        for idxs in plan.buckets:
+            size = sum(_leaf_size(plan.shapes[i]) for i in idxs)
+            seg = -(-size // n) * n // n
+            out.append(jax.ShapeDtypeStruct((seg,), plan.dtypes[idxs[0]]))
+        return out
+
+    opt_shapes = jax.eval_shape(optimizer.init, shard_structs())
+    specs = _opt_specs(opt_shapes, axis)
+
+    def init_fn(params):
+        return optimizer.init(_local_shards(flatten_buckets(params, plan), axis, n))
+
+    opt_state = jax.jit(
+        jax.shard_map(
+            init_fn, mesh=mesh, in_specs=(P(),), out_specs=specs, check_vma=False
+        )
+    )(params)
+    return params, opt_state
+
+
+def make_zero2_train_step(
+    loss_fn,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    axis: str = "fsdp",
+    bucket_size_mb: float | None | str = "auto",
+    donate: bool = True,
+):
+    """Explicit ZeRO-2: ``step(params, opt_state, x, y)`` with replicated
+    params, per-bucket gradient REDUCE-SCATTER, optimizer on each rank's
+    flat shard (state sharded n×), and per-bucket all-gather of the updated
+    shards. ``loss_fn(params, x, y)`` returns the mean loss over its batch
+    shard; the batch shards over ``axis`` (fsdp doubles as a data axis).
+
+    Restricted to elementwise optimizers (see ``_opt_specs``); initialize
+    state with :func:`init_zero2` using the same ``bucket_size_mb``.
+    ``bucket_size_mb``: ``"auto"`` = ``DSML_BUCKET_MB`` env default (4 MiB),
+    a number = that many MiB, ``None`` = one bucket per dtype (the
+    single-buffer A/B shape: the whole gradient space reduce-scatters as
+    one collective per dtype — no backward/comm overlap possible).
+    """
+    if bucket_size_mb == "auto":
+        bucket_size_mb = default_bucket_mb()
+    n = mesh.shape[axis]
+    batch_sh = NamedSharding(mesh, P(axis))
+    optimizer = optax.with_extra_args_support(optimizer)
+    # None → a single huge target so every dtype packs into ONE bucket
+    plan_mb = bucket_size_mb if bucket_size_mb is not None else float("inf")
+
+    def step(params, opt_state, x, y):
+        plan = plan_buckets(params, plan_mb)
+        specs = _opt_specs(opt_state, axis)
+
+        def shard_fn(params, opt_state, x, y):
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+            loss = lax.pmean(loss, axis)
+            gbuckets = flatten_buckets(grads, plan)
+            sizes = [g.shape[0] for g in gbuckets]
+            # one reduce-scatter per bucket: independent collectives the
+            # scheduler can overlap with still-running backward compute
+            gshards = [flat_reduce_scatter(g, axis, ReduceOp.AVG)[0] for g in gbuckets]
+            pshards = _local_shards(flatten_buckets(params, plan), axis, n)
+            updates, opt_state = optimizer.update(
+                gshards, opt_state, pshards, value=loss
+            )
+            new_shards = optax.apply_updates(pshards, updates)
+            new_buckets = [
+                flat_all_gather(s, axis, size)
+                for s, size in zip(new_shards, sizes)
+            ]
+            return unflatten_buckets(new_buckets, plan), opt_state, loss
+
+        return jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(), specs, P(axis), P(axis)),
+            out_specs=(P(), specs, P()),
+            check_vma=False,
+        )(params, opt_state, x, y)
+
+    jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+    def run(params, opt_state, x, y):
+        x = jax.device_put(x, batch_sh)
+        y = jax.device_put(y, batch_sh)
+        return jitted(params, opt_state, x, y)
+
+    return run

@@ -83,6 +83,8 @@ def make_hybrid_train_step(
     grad_accum: int = 1,
     n_microbatches: int = 1,
     schedule: str = "gpipe",
+    dp_sync: str = "xla",
+    bucket_size_mb: float | None | str = "auto",
 ):
     """Build ``step(params, opt_state, x, y) -> (params, opt_state, loss)``.
 
@@ -105,6 +107,20 @@ def make_hybrid_train_step(
       schedule-bounded at ≤ 2(pp−1)+1 microbatches with stage recompute.
       Same bubble fraction as GPipe (synchronous flush), much flatter
       memory in M.
+
+    ``dp_sync`` picks the gradient-sync mechanism on dp-ONLY meshes (every
+    other axis size 1): ``"xla"`` (default) keeps the shard_map-transpose
+    psum — one sync per microbatch, XLA's collective choice. Any explicit
+    algorithm (``"ring"``/``"ring2"``/``"naive"``/``"auto"``/``"q8"``)
+    instead accumulates LOCAL per-rank gradients across the grad-accum
+    microbatches and syncs ONCE per step as per-bucket collectives
+    (``parallel.bucketing``, ~``bucket_size_mb`` MiB each, ``"auto"`` =
+    the 4 MiB env default, ``None`` = one buffer) — grad_accum× fewer
+    bytes on the wire and per-bucket overlap with the backward. Per-rank
+    differentiation is exact here precisely because no collective crosses
+    ranks inside the loss on a dp-only mesh; meshes with tp/sp/pp/fsdp > 1
+    reject explicit ``dp_sync`` rather than compute silently-wrong
+    cotangents.
     """
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
@@ -189,6 +205,75 @@ def make_hybrid_train_step(
             loss = lax.pmean(loss, rest)
         (grads,) = fsdp_vjp(grads_full)
         return loss, grads
+
+    if dp_sync != "xla":
+        # per-rank value_and_grad + one explicit bucketed sync is only
+        # exact when NO collective crosses ranks inside the loss — i.e. a
+        # dp-only mesh (psums over the size-1 tp/sp/pp axes are identities)
+        busy = {a: s for a in ("pp", "fsdp", "sp", "tp")
+                if (s := mesh.shape.get(a, 1)) > 1}
+        if busy:
+            raise ValueError(
+                f"dp_sync={dp_sync!r} requires a dp-only mesh; got {busy} — "
+                "use dp_sync='xla' on multi-axis meshes"
+            )
+        from dsml_tpu.ops.collectives import ReduceOp
+        from dsml_tpu.parallel.bucketing import bucketed_all_reduce, default_bucket_mb
+
+        mb = default_bucket_mb() if bucket_size_mb == "auto" else bucket_size_mb
+
+        def _explicit_per_rank(params, x, y):
+            def micro_grads(p, xm, ym):
+                return jax.value_and_grad(loss_fn)(p, xm, ym)
+
+            if grad_accum == 1:
+                loss, grads = micro_grads(params, x, y)
+            else:
+                micro = x.shape[0] // grad_accum
+                xs = x[: micro * grad_accum].reshape(grad_accum, micro, *x.shape[1:])
+                ys = y[: micro * grad_accum].reshape(grad_accum, micro, *y.shape[1:])
+
+                def body(carry, xy):
+                    loss_acc, grads_acc = carry
+                    loss, grads = micro_grads(params, *xy)
+                    return (loss_acc + loss,
+                            jax.tree.map(jax.numpy.add, grads_acc, grads)), None
+
+                zero = jax.tree.map(jax.numpy.zeros_like, params)
+                (loss, grads), _ = jax.lax.scan(body, (0.0, zero), (xs, ys))
+                loss = loss / grad_accum
+                grads = jax.tree.map(lambda g: g / grad_accum, grads)
+            # the step's ONLY cross-rank exchange: per-bucket collectives,
+            # once per step regardless of grad_accum
+            grads = bucketed_all_reduce(grads, "dp", ReduceOp.AVG, dp_sync, mb)
+            return lax.pmean(loss, "dp"), grads
+
+        explicit_step_grads = jax.shard_map(
+            _explicit_per_rank,
+            mesh=mesh,
+            in_specs=(pspecs, batch_spec, batch_spec),
+            out_specs=(P(), pspecs),
+            check_vma=False,
+        )
+
+        n_dp = mesh.shape.get("dp", 1)
+
+        def step(params, opt_state, x, y):
+            # the microbatch split runs on each rank's SHARD inside
+            # shard_map, so per-rank rows must divide — global-only
+            # divisibility would silently drop rows (or give 0-row
+            # microbatches) whenever batch/dp % grad_accum != 0
+            if grad_accum > 1 and x.shape[0] % (grad_accum * n_dp):
+                raise ValueError(
+                    f"global batch {x.shape[0]} not divisible by "
+                    f"grad_accum*dp = {grad_accum}*{n_dp}"
+                )
+            loss, grads = explicit_step_grads(params, x, y)
+            updates, opt_state = optimizer.update(grads, opt_state, params, value=loss)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
 
     if pp_axis and schedule == "1f1b":
         sharded_grads = jax.shard_map(

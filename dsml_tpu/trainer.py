@@ -37,7 +37,8 @@ class TrainConfig(Config):
     warmup_steps: int = field(0, help="linear warmup steps for the schedule")
     plateau_patience: int = field(5, help="plateau schedule: epochs-worth of steps without improvement before decaying")
     plateau_factor: float = field(0.5, help="plateau schedule: lr decay factor")
-    algorithm: str = field("xla", help="gradient sync: xla | ring | naive | q8 (int8-compressed)")
+    algorithm: str = field("xla", help="gradient sync: xla | ring | ring2 | auto | naive | q8 (int8-compressed)")
+    bucket_mb: float = field(0.0, help="explicit-sync gradient bucket size in MiB (0 = the DSML_BUCKET_MB default, currently 4; negative = single buffer, the pre-bucketing A/B shape)")
     dp: int = field(0, help="data-parallel devices (0 = all local)")
     seed: int = field(0, help="init + shuffle seed")
     log_metrics: str = field("", help="optional JSONL metrics path")
@@ -124,8 +125,11 @@ class Trainer:
 
     def _build(self, steps_per_epoch: int):
         optimizer = _make_optimizer(self.config, steps_per_epoch)
+        # 0 → "auto" (DSML_BUCKET_MB default), < 0 → None (single buffer)
+        bucket = self.config.bucket_mb
         self._step_fn = make_dp_train_step(
-            self.model.loss, optimizer, self.mesh, algorithm=self.config.algorithm
+            self.model.loss, optimizer, self.mesh, algorithm=self.config.algorithm,
+            bucket_size_mb="auto" if bucket == 0 else (None if bucket < 0 else bucket),
         )
         self._eval_fn = make_eval_step(self.model, self.mesh)
         return optimizer

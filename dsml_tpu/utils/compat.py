@@ -1,0 +1,68 @@
+"""Forward-compat shims so the framework runs on older jax (0.4.x).
+
+The codebase targets the current jax surface (``jax.shard_map`` with
+``check_vma``, ``jax.lax.axis_size``, ``jax.set_mesh``); the container pins
+jax 0.4.37, where those live under older names or don't exist. ``install()``
+grafts the missing names onto the installed jax IN TERMS OF its own
+primitives — on a new-enough jax every branch is a no-op, so the shim
+evaporates the day the pin moves.
+
+Installed from ``dsml_tpu/__init__`` (every framework import path) and from
+``tests/conftest.py`` (tests that call ``jax.shard_map`` directly before
+importing any ``dsml_tpu`` module).
+
+What is NOT shimmed: ``jax.typeof(...).vma`` (varying-manual-axes tracking,
+the 1F1B pipeline schedule's foundation) has no 0.4.x equivalent — the 1F1B
+paths raise on old jax rather than silently computing wrong gradients.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+_installed = False
+
+
+def install() -> None:
+    """Idempotently graft missing new-jax names onto the installed jax."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+
+    import jax
+    from jax import lax
+
+    if not hasattr(lax, "axis_size"):
+        def axis_size(axis_name):
+            # inside shard_map/pmap a psum of the Python constant 1 folds to
+            # the static axis size (an int), which is exactly what callers
+            # use for trace-time schedule decisions
+            return lax.psum(1, axis_name)
+
+        lax.axis_size = axis_size
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                      check_vma=None, check_rep=None, **kwargs):
+            # check_vma (new name) ⇒ check_rep (old name). The framework
+            # passes check_vma=False everywhere except 1F1B; both map 1:1.
+            if check_rep is None:
+                check_rep = True if check_vma is None else bool(check_vma)
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_rep,
+                              **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax, "set_mesh"):
+        @contextlib.contextmanager
+        def set_mesh(mesh):
+            # old jax has no global-mesh context; every framework shard_map
+            # names its mesh explicitly, so entering the context is enough
+            with mesh:
+                yield mesh
+
+        jax.set_mesh = set_mesh

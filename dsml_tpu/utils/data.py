@@ -372,7 +372,10 @@ def build_prose_corpus(max_bytes: int = 4_000_000) -> str:
         for _, obj in sorted(vars(mod).items()):
             if not (inspect.isclass(obj) or inspect.isfunction(obj)):
                 continue
-            if not getattr(obj, "__module__", "").startswith(modname.split(".")[0]):
+            # `or ""`: C-extension objects may carry __module__ = None
+            if not (getattr(obj, "__module__", "") or "").startswith(
+                modname.split(".")[0]
+            ):
                 continue  # re-exports would duplicate across modules
             add(inspect.getdoc(obj))
             if inspect.isclass(obj):

@@ -15,12 +15,24 @@ def configure_platform(
     virtual device count (0 = leave as-is), and the CPU cross-process
     collectives backend ("gloo" for multi-process CPU clusters — required
     before :func:`init_distributed` on CPU)."""
+    import os
+
     import jax
 
     if platform:
         jax.config.update("jax_platforms", platform)
     if cpu_devices:
-        jax.config.update("jax_num_cpu_devices", cpu_devices)
+        try:
+            jax.config.update("jax_num_cpu_devices", cpu_devices)
+        except AttributeError:
+            # jax < 0.5: the device count comes from XLA_FLAGS, read at
+            # backend init — effective only if no backend has initialized
+            # yet (same caveat the config option carries on new jax)
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count={cpu_devices}"
+                ).strip()
     if cpu_collectives:
         jax.config.update("jax_cpu_collectives_implementation", cpu_collectives)
 

@@ -16,6 +16,7 @@ Exits 0 once every section has been captured on a real TPU.
 
 from __future__ import annotations
 
+import datetime
 import json
 import os
 import subprocess
@@ -68,18 +69,49 @@ def probe_alive(timeout: float = 120.0) -> bool:
         return False
 
 
+def _parse_ts(ts: str) -> datetime.datetime | None:
+    """ISO-8601 → aware UTC datetime; None on any parse failure. Accepts
+    the evidence file's ``...Z`` form, explicit offsets, and naive stamps
+    (assumed UTC — the writer uses gmtime)."""
+    try:
+        dt = datetime.datetime.fromisoformat(str(ts).strip().replace("Z", "+00:00"))
+    except ValueError:
+        return None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return dt
+
+
 def captured_sections() -> set:
     """Sections whose rows are already fresh. ``TPU_WATCH_REFRESH_BEFORE``
     (ISO-8601 UTC, e.g. the round's start time) treats any capture older
     than that as pending, so a new round re-measures every row instead of
-    trusting last round's dates."""
-    cutoff = os.environ.get("TPU_WATCH_REFRESH_BEFORE", "")
+    trusting last round's dates.
+
+    Timestamps are PARSED (``datetime.fromisoformat``), not string-compared
+    (ADVICE r5: a stored stamp whose format deviates from the cutoff's
+    ISO-8601-Z form — offset suffix, missing Z — compared incorrectly under
+    lexicographic order). An unparsable stored stamp counts as STALE
+    (re-measure: wrong side to fail safe on is "fresh"); an unparsable
+    cutoff disables filtering loudly rather than silently re-running
+    everything forever."""
+    cutoff_raw = os.environ.get("TPU_WATCH_REFRESH_BEFORE", "")
+    cutoff = _parse_ts(cutoff_raw) if cutoff_raw else None
+    if cutoff_raw and cutoff is None:
+        log(f"TPU_WATCH_REFRESH_BEFORE={cutoff_raw!r} is not ISO-8601; "
+            "ignoring the cutoff (all captured sections count as fresh)")
     try:
         with open(EVIDENCE) as f:
             log_entries = json.load(f).get("capture_log", {})
-        # ISO-8601 Z timestamps compare correctly as strings
-        return {n for n, ts in log_entries.items()
-                if not cutoff or str(ts) >= cutoff}
+        fresh = set()
+        for name, ts in log_entries.items():
+            if cutoff is None:
+                fresh.add(name)
+                continue
+            stamp = _parse_ts(ts)
+            if stamp is not None and stamp >= cutoff:
+                fresh.add(name)  # parse failure ⇒ stale ⇒ re-capture
+        return fresh
     except (OSError, ValueError):
         return set()
 

@@ -24,7 +24,20 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices option; the XLA_FLAGS fallback
+    # set above (before any backend initializes) provides the 8 virtual
+    # devices instead. Nothing else to do here — asserting now would
+    # initialize the backend before other conftest-time config lands.
+    pass
+
+from dsml_tpu.utils import compat  # noqa: E402
+
+# old-jax shims (jax.shard_map / lax.axis_size / jax.set_mesh) for tests
+# that call them directly before importing any dsml_tpu module
+compat.install()
 
 import pytest  # noqa: E402
 

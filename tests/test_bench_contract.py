@@ -58,13 +58,15 @@ def test_watchdog_emits_while_probe_hangs():
             "BENCH_SIM_HUNG_PROBE": "1",
             "BENCH_BUDGET_S": "600",      # soft budget never fires
             "BENCH_PREFLIGHT_S": "500",   # preflight alone would sit ~500 s
-            # the stall trigger (production default 420 s, sized to the XL
-            # remote compile) shortened so the suite pays seconds
+            # the stall trigger (production default 600 s, sized to the XL
+            # remote compile + heartbeat) shortened so the suite pays seconds
             "BENCH_STALL_S": "8",
         },
         timeout=150,
     )
-    assert rc == 0
+    # a watchdog abort is NOT a clean run: the JSON line is flushed but the
+    # return code must say aborted (bench.WATCHDOG_EXIT_CODE, ADVICE r5)
+    assert rc == 3
     head = _parse_one_json_line(out)
     assert head["metric"]  # headline shape present even with value null
     assert head["vs_baseline"] is None  # no TPU signal -> no ratio
