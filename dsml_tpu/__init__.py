@@ -14,7 +14,11 @@ NCCL-style data-parallel pipeline, see ``SURVEY.md``) for real TPU hardware:
   real device buffers instead of simulated byte maps.
 - ``dsml_tpu.runtime``   — native (C++) host runtime: buffer/address registry,
   stream engine, IDX data parsing.
-- ``dsml_tpu.utils``     — config, logging, metrics, checkpointing, tracing.
+- ``dsml_tpu.checkpoint`` — preemption-safe sharded checkpointing: native
+  binary-piece + JSON-manifest format, async atomic commits, resumable
+  data iterators (``docs/CHECKPOINT.md``).
+- ``dsml_tpu.utils``     — config, logging, metrics, tracing, and the
+  checkpoint compat front-end (``utils.checkpoint.Checkpointer``).
 
 The package name is the importable form of the repo's
 ``distributed-machine-learning-pipeline_tpu`` framework ("DSML" is the
@@ -33,7 +37,8 @@ _compat.install()
 
 # Lazy subpackage access keeps the heavy subpackages (models, comm, …) out
 # of the import path until used.
-_SUBPACKAGES = ("ops", "parallel", "models", "comm", "runtime", "utils", "cli")
+_SUBPACKAGES = ("ops", "parallel", "models", "comm", "runtime", "utils", "cli",
+                "checkpoint")
 
 
 def __getattr__(name):

@@ -1,0 +1,28 @@
+"""Preemption-safe sharded checkpointing (``docs/CHECKPOINT.md``).
+
+The subsystem the reference lacked entirely (SURVEY.md §5.4: a crash loses
+the run) and pod-scale TPU training treats as a first-order throughput term
+(preemption-driven scheduling): sharding-aware save/restore with no
+dependency beyond numpy.
+
+- :mod:`native` — the on-disk format: per-leaf binary piece files (unique
+  shards only — ZeRO-2 state writes 1/n of the bytes) + a JSON manifest
+  (tree paths, shapes, dtypes, sharding specs), committed atomically via
+  write-to-temp + ``os.replace``.
+- :mod:`async_writer` — background commit thread; the step loop pays only
+  the device→host snapshot, never the disk.
+- :mod:`manager` — :class:`CheckpointManager`: ``save``/``restore``/
+  ``latest_step``/``max_to_keep`` GC/partial (weights-only) restore, plus
+  the manifest-side ``iterator_state`` hook.
+- :mod:`iterator` — :class:`ResumableIterator`: persists the data-loader
+  position for bit-identical resume.
+
+``utils.checkpoint.Checkpointer`` remains as a thin compat front-end
+(orbax optional, selected explicitly).
+"""
+
+from dsml_tpu.checkpoint.async_writer import AsyncWriter
+from dsml_tpu.checkpoint.iterator import ResumableIterator
+from dsml_tpu.checkpoint.manager import CheckpointManager
+
+__all__ = ["AsyncWriter", "CheckpointManager", "ResumableIterator"]

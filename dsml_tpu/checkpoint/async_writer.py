@@ -1,0 +1,98 @@
+"""Background commit thread for async checkpoint saves.
+
+The save path splits in two: the SNAPSHOT (device→host copy,
+``native.snapshot``) happens synchronously on the caller's thread — after it
+returns, the training step is free to donate/overwrite every source buffer —
+and the COMMIT (file writes + atomic rename + garbage collection) runs here,
+overlapping the next steps' compute. One worker thread, FIFO order, so saves
+commit in submission order and ``max_to_keep`` GC never races a commit.
+
+Errors from a background commit don't vanish: the first failure is held and
+re-raised on the next :meth:`submit`, :meth:`wait`, or :meth:`close` — a
+training loop that keeps calling ``save`` finds out about a full disk on the
+very next save, not at shutdown.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+
+class AsyncWriter:
+    """Single-threaded FIFO job runner with sticky first-error propagation."""
+
+    def __init__(self, name: str = "ckpt-writer"):
+        self._name = name
+        self._jobs: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._busy = False
+        self._error: BaseException | None = None
+        self._thread: threading.Thread | None = None
+        self._closed = False
+
+    def submit(self, fn) -> None:
+        """Queue ``fn()`` for background execution; raises any held error
+        from a previous job first."""
+        self.check_error()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("AsyncWriter is closed")
+            self._jobs.append(fn)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name=self._name, daemon=True
+                )
+                self._thread.start()
+            self._idle.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._jobs and not self._closed:
+                    self._idle.wait(timeout=1.0)
+                if not self._jobs:
+                    return  # closed and drained
+                fn = self._jobs.popleft()
+                self._busy = True
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — held for the caller
+                with self._lock:
+                    if self._error is None:
+                        self._error = e
+            finally:
+                with self._lock:
+                    self._busy = False
+                    self._idle.notify_all()
+
+    def check_error(self) -> None:
+        """Re-raise (and clear) the held first error, non-blocking."""
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def wait(self) -> None:
+        """Block until every submitted job has finished; re-raise the first
+        failure."""
+        with self._lock:
+            while self._jobs or self._busy:
+                self._idle.wait()
+        self.check_error()
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._jobs) + (1 if self._busy else 0)
+
+    def close(self) -> None:
+        """Drain the queue, surface any held error, and stop the thread."""
+        try:
+            self.wait()
+        finally:
+            with self._lock:
+                self._closed = True
+                self._idle.notify_all()
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)

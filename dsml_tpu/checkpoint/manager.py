@@ -1,0 +1,153 @@
+"""CheckpointManager — the front-end of the native checkpoint subsystem.
+
+Directory layout (one run directory, many steps)::
+
+    <dir>/step_00000042/manifest.json   # tree paths, shapes, dtypes,
+    <dir>/step_00000042/L00003_P001.bin #   shardings, piece index
+    <dir>/.tmp.step_00000043/...        # in-flight write (invisible to
+                                        #   latest_step until renamed)
+
+``save(..., wait=False)`` snapshots device arrays to host BEFORE returning
+(donation-safe) and commits on a background thread — the step loop never
+stalls on disk. ``max_to_keep`` garbage-collects old steps after each
+commit. ``restore`` is sharding-aware: the template's shardings drive the
+relayout, so a checkpoint saved on one mesh restores onto another (see
+``native.restore_tree``). ``iterator_state`` rides in the manifest so a
+resume can put the data loader back at the exact batch it stopped at
+(``checkpoint.iterator.ResumableIterator``).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any
+
+from dsml_tpu.checkpoint import native
+from dsml_tpu.checkpoint.async_writer import AsyncWriter
+from dsml_tpu.utils.logging import get_logger
+
+log = get_logger("checkpoint")
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int | None = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self._writer = AsyncWriter()
+
+    # -- write ------------------------------------------------------------
+
+    def save(
+        self,
+        step: int,
+        state: Any,
+        *,
+        iterator_state: dict | None = None,
+        meta: dict | None = None,
+        wait: bool = True,
+    ) -> None:
+        """Persist ``state`` as step ``step``. With ``wait=False`` only the
+        host snapshot happens here; the disk commit overlaps training and is
+        made durable by the next ``wait_until_finished``/``close`` (or
+        absorbed by a later save's barrier). ``iterator_state`` /``meta``
+        must be JSON-serializable; they land in the manifest, not as
+        leaves."""
+        extra = {}
+        if iterator_state is not None:
+            extra["iterator"] = iterator_state
+        if meta:
+            extra["meta"] = dict(meta)
+        snap = native.snapshot(state, step=step, extra=extra)
+        directory = self.directory
+
+        def job():
+            native.commit(directory, snap)
+            self._gc()
+
+        self._writer.submit(job)
+        if wait:
+            self._writer.wait()
+            log.info("saved checkpoint step %d -> %s", step, directory)
+        else:
+            log.info("scheduled async checkpoint save step %d -> %s", step, directory)
+
+    def wait_until_finished(self) -> None:
+        """Block until every in-flight async save has committed (re-raising
+        any background write failure)."""
+        self._writer.wait()
+
+    def _gc(self) -> None:
+        if not self.max_to_keep or self.max_to_keep < 1:
+            return
+        steps = self.all_steps()
+        for step in steps[: -self.max_to_keep]:
+            path = os.path.join(self.directory, native.step_dirname(step))
+            # rename-then-delete: a reader listing steps mid-GC never sees a
+            # half-deleted directory as a valid checkpoint
+            trash = os.path.join(self.directory, f".trash.{native.step_dirname(step)}")
+            try:
+                os.replace(path, trash)
+                shutil.rmtree(trash)
+            except OSError:  # already gone (concurrent GC) — fine
+                continue
+            log.info("garbage-collected checkpoint step %d", step)
+
+    # -- read -------------------------------------------------------------
+
+    def all_steps(self) -> list[int]:
+        """Committed steps, ascending. Only directories with a manifest
+        count — an interrupted write (temp dir) is invisible."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            step = native.parse_step_dirname(name)
+            if step is None:
+                continue
+            if os.path.exists(os.path.join(self.directory, name, native.MANIFEST)):
+                out.append(step)
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def _step_dir(self, step: int | None) -> str:
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        path = os.path.join(self.directory, native.step_dirname(step))
+        if not os.path.exists(os.path.join(path, native.MANIFEST)):
+            raise FileNotFoundError(f"no committed checkpoint for step {step} under {self.directory}")
+        return path
+
+    def restore(self, step: int | None = None, template: Any = None,
+                partial: bool = False) -> Any:
+        """Restore state (latest step when ``step`` is None). With a
+        ``template`` (arrays or ShapeDtypeStructs), leaves come back with
+        the template's dtypes and shardings; ``partial=True`` restores only
+        the subtree the template names (the weights-only inference path)."""
+        return native.restore_tree(self._step_dir(step), template, partial)
+
+    def iterator_state(self, step: int | None = None) -> dict | None:
+        """The data-loader position saved with this step (None if absent)."""
+        return native.read_manifest(self._step_dir(step))["extra"].get("iterator")
+
+    def meta(self, step: int | None = None) -> dict:
+        return native.read_manifest(self._step_dir(step))["extra"].get("meta", {})
+
+    # -- lifecycle --------------------------------------------------------
+
+    def close(self) -> None:
+        self._writer.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

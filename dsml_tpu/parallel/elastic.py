@@ -42,7 +42,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dsml_tpu.parallel.auto import plan_mesh
 from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
 
-__all__ = ["ElasticPolicy", "check_recoverable", "reconfigure", "ElasticState"]
+__all__ = [
+    "ElasticPolicy",
+    "check_recoverable",
+    "reconfigure",
+    "restore_from_checkpoint",
+    "ElasticState",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -129,6 +135,45 @@ def check_recoverable(state, lost_devices) -> list[str]:
     return [descr for _, descr in _torn_leaves(state, lost_devices)]
 
 
+def _plan_for_survivors(
+    model, n_params: int, survivors: list, batch_per_device: int,
+    global_batch: int | None, planner_overrides: dict | None,
+):
+    """Re-instantiate the parallelism template on the survivor fleet (the
+    Oobleck choice): the capacity-rule plan for the largest device subset
+    whose dp×fsdp width divides ``global_batch`` (both axes shard batch
+    rows in the hybrid step). Returns (plan, survivors_used)."""
+    cfg = getattr(model, "config", None)
+    plan = None
+    for n_use in range(len(survivors), 0, -1):
+        candidate = plan_mesh(
+            n_devices=n_use,
+            n_params=n_params,
+            n_head=getattr(cfg, "n_head", None),
+            seq_len=getattr(cfg, "max_seq", 0),
+            d_model=getattr(cfg, "d_model", 0),
+            n_layer=getattr(cfg, "n_layer", 0),
+            batch_per_device=batch_per_device,
+            **(planner_overrides or {}),
+        )
+        if global_batch is None or global_batch % (
+            candidate.spec.dp * candidate.spec.fsdp
+        ) == 0:
+            plan = candidate
+            if n_use < len(survivors):
+                plan = dataclasses.replace(
+                    plan,
+                    reasons=plan.reasons
+                    + (
+                        f"global batch {global_batch} not divisible by the "
+                        f"{len(survivors)}-chip plan's dp×fsdp → instantiated on "
+                        f"{n_use} chips, {len(survivors) - n_use} idle",
+                    ),
+                )
+            return plan, survivors[:n_use]
+    raise AssertionError("unreachable: the n_use=1 plan always divides")
+
+
 def reconfigure(
     model,
     optimizer,
@@ -190,38 +235,10 @@ def reconfigure(
 
     cfg = getattr(model, "config", None)
     old_pp = isinstance(params.get("layers"), dict) if isinstance(params, dict) else False
-    survivors = list(surviving_devices)
-    plan = None
-    for n_use in range(len(survivors), 0, -1):
-        candidate = plan_mesh(
-            n_devices=n_use,
-            n_params=model.n_params(params),
-            n_head=getattr(cfg, "n_head", None),
-            seq_len=getattr(cfg, "max_seq", 0),
-            d_model=getattr(cfg, "d_model", 0),
-            n_layer=getattr(cfg, "n_layer", 0),
-            batch_per_device=batch_per_device,
-            **(planner_overrides or {}),
-        )
-        # the hybrid step shards batch rows over dp × fsdp (fsdp doubles as
-        # a data axis), so BOTH must divide the batch for the plan to run
-        if global_batch is None or global_batch % (
-            candidate.spec.dp * candidate.spec.fsdp
-        ) == 0:
-            plan = candidate
-            if n_use < len(survivors):
-                plan = dataclasses.replace(
-                    plan,
-                    reasons=plan.reasons
-                    + (
-                        f"global batch {global_batch} not divisible by the "
-                        f"{len(survivors)}-chip plan's dp×fsdp → instantiated on "
-                        f"{n_use} chips, {len(survivors) - n_use} idle",
-                    ),
-                )
-            survivors = survivors[:n_use]
-            break
-    assert plan is not None  # n_use=1 always divides
+    plan, survivors = _plan_for_survivors(
+        model, model.n_params(params), list(surviving_devices),
+        batch_per_device, global_batch, planner_overrides,
+    )
     new_mesh = build_mesh(plan.spec, survivors)
 
     # host round-trip: survivors hold every piece (audited above, unless the
@@ -276,87 +293,106 @@ def reconfigure(
         # whenever v>1 and the stage count changed. Unstack params, and
         # apply the SAME transform to every params-shaped subtree of the
         # optimizer state (adam's mu/nu mirror the param tree)
-        n_layer = jax.tree.leaves(host_params["layers"])[0].shape[0]
-        # interleaved pipelines stacked the layers in chunk-permuted order
-        # (hybrid.init_hybrid); invert it so the list comes back in model order
-        v = getattr(cfg, "pp_interleave", 1)
-        if v > 1:
-            from dsml_tpu.parallel.pp import interleave_layer_order
-
-            old_pp_size = None
-            for leaf, sharding in _leaf_shardings(params):
-                if isinstance(sharding, NamedSharding) and "pp" in sharding.mesh.shape:
-                    old_pp_size = sharding.mesh.shape["pp"]
-                    break
-            order = interleave_layer_order(n_layer, old_pp_size or 1, v)
-            inverse = [0] * n_layer
-            for pos, orig in enumerate(order):
-                inverse[orig] = pos
-        else:
-            inverse = list(range(n_layer))
-
-        def unstack(node):
-            if isinstance(node, dict):
-                if "layers" in node and isinstance(node["layers"], dict):
-                    permuted = [
-                        jax.tree.map(lambda l: l[i], node["layers"]) for i in range(n_layer)
-                    ]
-                    layers = [permuted[inverse[i]] for i in range(n_layer)]
-                    return {
-                        **{k: unstack(v) for k, v in node.items() if k != "layers"},
-                        "layers": layers,
-                    }
-                return {k: unstack(v) for k, v in node.items()}
-            if isinstance(node, tuple):
-                mapped = [unstack(v) for v in node]
-                return type(node)(*mapped) if hasattr(node, "_fields") else tuple(mapped)
-            if isinstance(node, list):
-                return [unstack(v) for v in node]
-            return node
-
-        host_params = unstack(host_params)
-        host_opt = unstack(host_opt)
-    if plan.spec.pp > 1:
-        # new plan keeps a pipeline: restack (in the new stage count's
-        # interleave order when v>1) — today's planner never emits pp>1,
-        # but the state transform must not silently depend on that
-        from dsml_tpu.parallel.pp import interleave_layer_order, stack_layer_params
-
-        v_new = getattr(cfg, "pp_interleave", 1)
-        n_layer = len(host_params["layers"])
-        order_new = (
-            interleave_layer_order(n_layer, plan.spec.pp, v_new)
-            if v_new > 1
-            else list(range(n_layer))
+        old_pp_size = None
+        for leaf, sharding in _leaf_shardings(params):
+            if isinstance(sharding, NamedSharding) and "pp" in sharding.mesh.shape:
+                old_pp_size = sharding.mesh.shape["pp"]
+                break
+        host_params, host_opt = _unstack_state(
+            host_params, host_opt, cfg, old_pp_size or 1
         )
+    host_params, host_opt = _restack_state(host_params, host_opt, cfg, plan.spec.pp)
+    new_params, new_opt = _place_state(
+        host_params, host_opt, optimizer, pspecs, new_mesh
+    )
+    return ElasticState(
+        params=new_params, opt_state=new_opt, mesh=new_mesh, spec=plan.spec,
+        reasons=plan.reasons + torn_note,
+    )
 
-        def restack(node):
-            if isinstance(node, dict):
-                if "layers" in node and isinstance(node["layers"], list):
-                    layers = stack_layer_params([node["layers"][i] for i in order_new])
-                    return {
-                        **{k: restack(v) for k, v in node.items() if k != "layers"},
-                        "layers": layers,
-                    }
-                return {k: restack(v) for k, v in node.items()}
-            if isinstance(node, tuple):
-                mapped = [restack(v) for v in node]
-                return type(node)(*mapped) if hasattr(node, "_fields") else tuple(mapped)
-            if isinstance(node, list):
-                return [restack(v) for v in node]
-            return node
 
-        host_params = restack(host_params)
-        host_opt = restack(host_opt)
+def _map_layer_nodes(node, fn):
+    """Apply ``fn`` to every dict node carrying a 'layers' entry, recursing
+    through dicts/lists/(named)tuples — adam's mu/nu mirror the param tree,
+    so one transform must hit every params-shaped subtree of the state."""
+    if isinstance(node, dict):
+        node = fn(node)
+        return {k: _map_layer_nodes(v, fn) for k, v in node.items()}
+    if isinstance(node, tuple):
+        mapped = [_map_layer_nodes(v, fn) for v in node]
+        return type(node)(*mapped) if hasattr(node, "_fields") else tuple(mapped)
+    if isinstance(node, list):
+        return [_map_layer_nodes(v, fn) for v in node]
+    return node
+
+
+def _unstack_state(host_params, host_opt, cfg, old_pp_size: int):
+    """Stacked layer axis (possibly interleave-permuted for the OLD stage
+    count) → canonical per-layer list form, applied to params and every
+    params-shaped optimizer subtree."""
+    n_layer = jax.tree.leaves(host_params["layers"])[0].shape[0]
+    # interleaved pipelines stacked the layers in chunk-permuted order
+    # (hybrid.init_hybrid); invert it so the list comes back in model order
+    v = getattr(cfg, "pp_interleave", 1)
+    if v > 1:
+        from dsml_tpu.parallel.pp import interleave_layer_order
+
+        order = interleave_layer_order(n_layer, old_pp_size, v)
+        inverse = [0] * n_layer
+        for pos, orig in enumerate(order):
+            inverse[orig] = pos
+    else:
+        inverse = list(range(n_layer))
+
+    def unstack(node):
+        if "layers" in node and isinstance(node["layers"], dict):
+            permuted = [
+                jax.tree.map(lambda l: l[i], node["layers"]) for i in range(n_layer)
+            ]
+            return {**node, "layers": [permuted[inverse[i]] for i in range(n_layer)]}
+        return node
+
+    return _map_layer_nodes(host_params, unstack), _map_layer_nodes(host_opt, unstack)
+
+
+def _restack_state(host_params, host_opt, cfg, new_pp: int):
+    """Per-layer list form → stacked layer axis in the NEW stage count's
+    interleave order, when the new plan keeps a pipeline (identity when
+    ``new_pp <= 1`` — today's planner never emits pp>1, but the state
+    transform must not silently depend on that)."""
+    if new_pp <= 1:
+        return host_params, host_opt
+    from dsml_tpu.parallel.pp import interleave_layer_order, stack_layer_params
+
+    v_new = getattr(cfg, "pp_interleave", 1)
+    n_layer = len(host_params["layers"])
+    order_new = (
+        interleave_layer_order(n_layer, new_pp, v_new)
+        if v_new > 1
+        else list(range(n_layer))
+    )
+
+    def restack(node):
+        if "layers" in node and isinstance(node["layers"], list):
+            return {
+                **node,
+                "layers": stack_layer_params([node["layers"][i] for i in order_new]),
+            }
+        return node
+
+    return _map_layer_nodes(host_params, restack), _map_layer_nodes(host_opt, restack)
+
+
+def _place_state(host_params, host_opt, optimizer, pspecs, new_mesh):
+    """Lay host state out on the new mesh: params per their PartitionSpecs,
+    optimizer statistics adopting the param shardings directly (adam's
+    mu/nu mirror the param tree; scalars like the step count replicate) —
+    no fresh optimizer.init, whose transient zeros would double-allocate
+    HBM at exactly the moment a shrunken fleet has the least headroom."""
     from dsml_tpu.parallel.hybrid import shard_params
-
-    new_params = shard_params(host_params, new_mesh, pspecs)
-    # optimizer statistics adopt the param shardings directly (adam's mu/nu
-    # mirror the param tree; scalars like the step count replicate) — no
-    # fresh optimizer.init, whose transient zeros would double-allocate HBM
-    # at exactly the moment the shrunken fleet has the least headroom
     import optax.tree_utils as otu
 
+    new_params = shard_params(host_params, new_mesh, pspecs)
     param_shardings = jax.tree.map(
         lambda s: NamedSharding(new_mesh, s), pspecs, is_leaf=lambda x: isinstance(x, P)
     )
@@ -370,7 +406,90 @@ def reconfigure(
             jax.device_put(np.asarray(leaf), replicated) if leaf is not None else leaf
         ),
     )
+    return new_params, new_opt
+
+
+def restore_from_checkpoint(
+    manager,
+    model,
+    optimizer,
+    surviving_devices,
+    step: int | None = None,
+    seed: int = 0,
+    batch_per_device: int = 1,
+    global_batch: int | None = None,
+    planner_overrides: dict | None = None,
+) -> ElasticState:
+    """The Varuna-style fallback :func:`reconfigure` points at, as one call:
+    when the live state is torn (an entire pipeline stage / tp shard died
+    with its devices), re-plan the parallelism for the survivor fleet and
+    restore the checkpoint ONTO the new topology — the manifest's sharded
+    pieces re-lay onto whatever mesh the plan emits (different device count,
+    different layout; ``checkpoint.native``'s relayout path).
+
+    ``manager`` is a ``checkpoint.CheckpointManager`` (or a directory path).
+    A checkpoint saved from a pipeline mesh (stacked layer axis) restores
+    onto a pipeline-less plan and vice versa: the same unstack/restack
+    transforms :func:`reconfigure` applies to live state run on the restored
+    host tree, driven by the manifest's recorded pp width.
+    """
+    if isinstance(manager, str):
+        from dsml_tpu.checkpoint import CheckpointManager
+
+        manager = CheckpointManager(manager)
+    # resolve "latest" ONCE: an async save committing between the manifest
+    # read (form detection) and the restore would otherwise mix two steps
+    if step is None:
+        step = manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {manager.directory}")
+    cfg = getattr(model, "config", None)
+    host_params = jax.tree.map(np.asarray, jax.device_get(model.init(seed)))
+    plan, survivors = _plan_for_survivors(
+        model, model.n_params(host_params), list(surviving_devices),
+        batch_per_device, global_batch, planner_overrides,
+    )
+    new_mesh = build_mesh(plan.spec, survivors)
+
+    # what form did the SAVE use? the manifest records each leaf's path and
+    # sharding — a stacked run has 'params/layers/<field>' (dict) paths and
+    # a 'pp' mesh axis; a list-form run has 'params/layers/<int>/...'
+    from dsml_tpu.checkpoint import native as ckpt_native
+
+    manifest = ckpt_native.read_manifest(manager._step_dir(step))
+    saved_stacked = False
+    saved_pp = 1
+    for e in manifest["leaves"]:
+        parts = e["path"].split("/")
+        if len(parts) > 2 and parts[0] == "params" and parts[1] == "layers":
+            saved_stacked = not parts[2].isdigit()
+        sh = e.get("sharding")
+        if sh and "pp" in sh.get("mesh_axes", []):
+            saved_pp = sh["mesh_shape"][sh["mesh_axes"].index("pp")]
+    # host-shaped template in the SAVED form (stacked in the saved pp
+    # width's interleave order when the save ran a pipeline): the restore
+    # hands back host-placeable arrays we unstack/restack below before
+    # placing on the new mesh
+    t_params = host_params
+    if saved_stacked:
+        t_params, _ = _restack_state(t_params, {}, cfg, max(saved_pp, 2))
+    t_opt = jax.eval_shape(optimizer.init, t_params)
+    state = manager.restore(
+        step, template={"params": t_params, "opt_state": t_opt}, partial=True
+    )
+    host_p = jax.tree.map(np.asarray, jax.device_get(state["params"]))
+    host_o = jax.tree.map(
+        lambda l: np.asarray(l) if hasattr(l, "shape") else l,
+        jax.device_get(state["opt_state"]),
+    )
+    if saved_stacked:
+        host_p, host_o = _unstack_state(host_p, host_o, cfg, saved_pp)
+    host_p, host_o = _restack_state(host_p, host_o, cfg, plan.spec.pp)
+    pspecs = model.param_specs(pp=plan.spec.pp > 1, fsdp=plan.spec.fsdp)
+    new_params, new_opt = _place_state(host_p, host_o, optimizer, pspecs, new_mesh)
     return ElasticState(
         params=new_params, opt_state=new_opt, mesh=new_mesh, spec=plan.spec,
-        reasons=plan.reasons + torn_note,
+        reasons=plan.reasons
+        + (f"restored from checkpoint step {manifest['step']} "
+           f"(saved pp={saved_pp}, {'stacked' if saved_stacked else 'list'} form)",),
     )

@@ -44,6 +44,8 @@ __all__ = [
     "make_fsdp_train_step",
     "make_zero2_train_step",
     "init_zero2",
+    "zero2_abstract_state",
+    "restore_zero2",
 ]
 
 
@@ -171,6 +173,84 @@ def init_zero2(
         )
     )(params)
     return params, opt_state
+
+
+def zero2_abstract_state(
+    model,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    seed: int = 0,
+    axis: str = "fsdp",
+    bucket_size_mb: float | None | str = "auto",
+):
+    """(params_template, opt_template) as ShapeDtypeStructs carrying the
+    ZeRO-2 layout's NamedShardings — the allocation-free restore template
+    for :func:`restore_zero2` / ``CheckpointManager.restore``. Shapes are
+    GLOBAL: each flat optimizer leaf is its bucket's identity-padded size
+    for THIS mesh's ``axis`` width, so a checkpoint written at a different
+    width re-pads on restore (``checkpoint.native``'s 1-D resize rule —
+    the padding is provably zeros, adam/sgd moments of zero gradients)."""
+    if bucket_size_mb == "auto":
+        bucket_size_mb = default_bucket_mb()
+    n = mesh.shape[axis]
+    optimizer = optax.with_extra_args_support(optimizer)
+    host_params = model.init(seed)
+    plan = plan_buckets(
+        host_params, bucket_size_mb if bucket_size_mb is not None else float("inf")
+    )
+
+    def shard_structs():
+        out = []
+        for idxs in plan.buckets:
+            size = sum(_leaf_size(plan.shapes[i]) for i in idxs)
+            seg = -(-size // n) * n // n
+            out.append(jax.ShapeDtypeStruct((seg,), plan.dtypes[idxs[0]]))
+        return out
+
+    opt_shapes = jax.eval_shape(optimizer.init, shard_structs())
+    specs = _opt_specs(opt_shapes, axis)
+    repl = NamedSharding(mesh, P())
+    params_t = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(jnp.shape(l), jnp.result_type(l), sharding=repl),
+        host_params,
+    )
+    flat_sds, treedef = jax.tree.flatten(opt_shapes)
+    flat_specs = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    flat_t = []
+    for sds, spec in zip(flat_sds, flat_specs):
+        sharded = tuple(spec) and tuple(spec)[0] == axis
+        # eval_shape saw the PER-RANK segment; the live (and saved) arrays
+        # are the concatenation over ranks — scale dim 0 back to global
+        shape = (sds.shape[0] * n, *sds.shape[1:]) if sharded else sds.shape
+        flat_t.append(
+            jax.ShapeDtypeStruct(shape, sds.dtype, sharding=NamedSharding(mesh, spec))
+        )
+    return params_t, jax.tree.unflatten(treedef, flat_t)
+
+
+def restore_zero2(
+    manager,
+    model,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    step: int | None = None,
+    seed: int = 0,
+    axis: str = "fsdp",
+    bucket_size_mb: float | None | str = "auto",
+):
+    """Restore a ZeRO-2 run's (params, opt_state) from ``manager`` (a
+    ``checkpoint.CheckpointManager``) onto ``mesh`` — including onto a
+    DIFFERENT ``axis`` width than the save used: params are replicated
+    (width-invariant) and each rank re-slices its 1/n of the flat moment
+    buckets from the manifest's pieces. ``bucket_size_mb`` must match the
+    saving run's (the bucket plan defines the flat layout)."""
+    params_t, opt_t = zero2_abstract_state(
+        model, optimizer, mesh, seed=seed, axis=axis, bucket_size_mb=bucket_size_mb
+    )
+    state = manager.restore(
+        step, template={"params": params_t, "opt_state": opt_t}, partial=True
+    )
+    return state["params"], state["opt_state"]
 
 
 def make_zero2_train_step(

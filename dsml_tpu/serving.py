@@ -530,6 +530,40 @@ class ContinuousBatcher:
             )
         self._insert = jax.jit(self._insert_fn, donate_argnums=(0,))
 
+    @classmethod
+    def from_checkpoint(cls, model, directory, step: int | None = None,
+                        mesh=None, param_dtype=None, init_seed: int = 0, **kwargs):
+        """Serve straight from a training checkpoint: a WEIGHTS-ONLY partial
+        restore of the ``params`` subtree — the (n×-larger, n-way-sharded)
+        optimizer state is never read, which is the point of the partial
+        restore path (``docs/CHECKPOINT.md``). ``directory`` is a native
+        checkpoint run directory (or an open ``CheckpointManager``);
+        ``step=None`` loads the latest committed step. ``param_dtype``
+        casts on restore (e.g. serve a bf16-trained checkpoint as f32);
+        with ``mesh`` the restored weights land Megatron-sharded for the
+        TP serving path. Remaining kwargs go to the constructor."""
+        import jax as _jax
+
+        from dsml_tpu.checkpoint import CheckpointManager
+
+        manager = (directory if hasattr(directory, "restore")
+                   else CheckpointManager(directory))
+        template = model.init(init_seed)
+        if param_dtype is not None:
+            template = _jax.tree.map(
+                lambda l: l.astype(param_dtype)
+                if jnp.issubdtype(l.dtype, jnp.floating) else l,
+                template,
+            )
+        if mesh is not None:
+            from dsml_tpu.parallel.hybrid import shard_params
+
+            template = shard_params(template, mesh, model.param_specs())
+        params = manager.restore(
+            step, template={"params": template}, partial=True
+        )["params"]
+        return cls(model, params, mesh=mesh, **kwargs)
+
     @staticmethod
     def _insert_fn(cache, cache1, slot):
         """Scatter a 1-row prefill cache into slot ``slot`` of the big

@@ -42,8 +42,9 @@ class TrainConfig(Config):
     dp: int = field(0, help="data-parallel devices (0 = all local)")
     seed: int = field(0, help="init + shuffle seed")
     log_metrics: str = field("", help="optional JSONL metrics path")
-    checkpoint_dir: str = field("", help="Orbax checkpoint directory ('' = no checkpointing)")
+    checkpoint_dir: str = field("", help="checkpoint directory ('' = no checkpointing; native sharded backend, docs/CHECKPOINT.md)")
     save_every: int = field(1, help="checkpoint every N epochs")
+    keep_checkpoints: int = field(3, help="max checkpoints retained (older steps garbage-collected)")
     resume: bool = field(False, help="resume from the latest checkpoint in checkpoint_dir")
     progress: bool = field(False, help="draw per-epoch train/eval progress bars on stderr (reference client UX)")
 
@@ -152,9 +153,24 @@ class Trainer:
         ckpt = None
         start_epoch = 1
         if cfg.checkpoint_dir:
-            from dsml_tpu.utils.checkpoint import Checkpointer
+            from dsml_tpu.checkpoint import CheckpointManager
 
-            ckpt = Checkpointer(cfg.checkpoint_dir)
+            ckpt = CheckpointManager(cfg.checkpoint_dir,
+                                     max_to_keep=cfg.keep_checkpoints)
+            if cfg.resume and ckpt.latest_step() is None:
+                import os
+
+                foreign = [n for n in os.listdir(ckpt.directory) if n.isdigit()]
+                if foreign:
+                    # digit-named step dirs = the orbax layout the previous
+                    # Checkpointer wrote; restarting silently would redo
+                    # every completed epoch
+                    raise RuntimeError(
+                        f"resume=True but {cfg.checkpoint_dir} holds no native "
+                        f"checkpoints — found orbax-format step dirs {foreign[:3]}; "
+                        "restore them via utils.checkpoint.Checkpointer("
+                        "backend='orbax') or start a fresh checkpoint_dir"
+                    )
             if cfg.resume and ckpt.latest_step() is not None:
                 state = ckpt.restore(template={"params": params, "opt_state": opt_state,
                                                "meta": {"epoch": 0}})
@@ -192,15 +208,26 @@ class Trainer:
             )
             if ckpt is not None and epoch % max(cfg.save_every, 1) == 0:
                 # async: the write overlaps the next epoch's compute; the
-                # manager's internal barrier (or close()) commits it
-                ckpt.save(epoch, params, opt_state, meta={"epoch": epoch}, wait=False)
+                # manager's writer barrier (or close()) commits it. Saves
+                # land at epoch boundaries, so the loader position is just
+                # the NEXT epoch's seed — shard_batches re-derives the
+                # shuffle from (cfg.seed + epoch), making resume
+                # bit-identical to the uninterrupted run
+                ckpt.save(epoch,
+                          {"params": params, "opt_state": opt_state,
+                           "meta": {"epoch": epoch}},
+                          iterator_state={"epoch": epoch, "consumed": 0},
+                          wait=False)
         last_epoch = cfg.epochs
         if ckpt is not None:
             # final state must always be persisted, even when epochs isn't a
             # multiple of save_every (otherwise the reported model is lost and
             # resume would redo the last epochs)
             if last_epoch >= start_epoch and last_epoch % max(cfg.save_every, 1) != 0:
-                ckpt.save(last_epoch, params, opt_state, meta={"epoch": last_epoch})
+                ckpt.save(last_epoch,
+                          {"params": params, "opt_state": opt_state,
+                           "meta": {"epoch": last_epoch}},
+                          iterator_state={"epoch": last_epoch, "consumed": 0})
             ckpt.close()
         test_acc = self.evaluate(
             params, data.test_x, data.test_y,

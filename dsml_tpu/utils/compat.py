@@ -11,9 +11,14 @@ Installed from ``dsml_tpu/__init__`` (every framework import path) and from
 ``tests/conftest.py`` (tests that call ``jax.shard_map`` directly before
 importing any ``dsml_tpu`` module).
 
-What is NOT shimmed: ``jax.typeof(...).vma`` (varying-manual-axes tracking,
-the 1F1B pipeline schedule's foundation) has no 0.4.x equivalent — the 1F1B
-paths raise on old jax rather than silently computing wrong gradients.
+``jax.typeof`` / ``lax.pcast`` (varying-manual-axes tracking, which the
+1F1B pipeline schedule uses to keep scan-carry types stable) are shimmed to
+the 0.4.x semantics of ``check_rep=False``: there IS no vma tracking, every
+per-shard value is implicitly varying, so ``typeof(x).vma`` reports every
+axis (making ``_lift``'s "which axes are missing" computation the empty
+set) and ``pcast`` is the identity. Collective transposes are exact on
+0.4.x under ``check_rep=False`` — psum transposes to psum — which the 1F1B
+gradient-parity test pins against a single-device reference.
 """
 
 from __future__ import annotations
@@ -47,15 +52,64 @@ def install() -> None:
 
         def shard_map(f, mesh=None, in_specs=None, out_specs=None,
                       check_vma=None, check_rep=None, **kwargs):
-            # check_vma (new name) ⇒ check_rep (old name). The framework
-            # passes check_vma=False everywhere except 1F1B; both map 1:1.
+            # check_vma (new name) ⇒ check_rep (old name) — EXCEPT that
+            # check_vma=True programs (the 1F1B schedule's per-tick vjps
+            # with internal collectives) are exactly what 0.4.x's
+            # replication checker cannot validate: it predates pcast/vma
+            # and rejects them spuriously. Old jax runs them unchecked;
+            # the 1F1B gradient-parity test pins that the VALUES agree.
             if check_rep is None:
-                check_rep = True if check_vma is None else bool(check_vma)
+                check_rep = False
             return _shard_map(f, mesh=mesh, in_specs=in_specs,
                               out_specs=out_specs, check_rep=check_rep,
                               **kwargs)
 
         jax.shard_map = shard_map
+
+    if not hasattr(jax, "typeof"):
+        # consumers that must compensate for the missing vma transpose
+        # bookkeeping (models.gpt2.train_grads_1f1b_spmd's seed scaling)
+        # key off this flag rather than sniffing jax versions
+        jax._dsml_shimmed_vma = True
+
+        class _AvalView:
+            """Minimal stand-in for the new-jax aval ``typeof`` returns:
+            delegates to the real 0.4.x aval, except ``.vma`` reports
+            EVERY bound axis name — under old shard_map there is no
+            replication tracking, so "varying over all mesh axes" is the
+            honest type and makes the 1F1B ``_lift`` helper a no-op."""
+
+            __slots__ = ("_aval",)
+
+            def __init__(self, aval):
+                self._aval = aval
+
+            @property
+            def vma(self):
+                from jax._src.core import unsafe_get_axis_names
+
+                return frozenset(
+                    n for n in unsafe_get_axis_names() if isinstance(n, str)
+                )
+
+            def __getattr__(self, name):
+                return getattr(self._aval, name)
+
+        def typeof(x):
+            from jax.core import get_aval
+
+            return _AvalView(get_aval(x))
+
+        jax.typeof = typeof
+
+    if not hasattr(lax, "pcast"):
+        def pcast(x, axis_name, *, to=None, **_kw):
+            # no vma tracking on 0.4.x ⇒ values are already "varying";
+            # casting is the identity on values
+            del axis_name, to
+            return x
+
+        lax.pcast = pcast
 
     if not hasattr(jax, "set_mesh"):
         @contextlib.contextmanager

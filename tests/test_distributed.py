@@ -28,9 +28,39 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _gloo_capability() -> str | None:
+    """Probe (in a subprocess, so this process's jax stays untouched)
+    whether the installed jax can stand up the worker's platform shape:
+    gloo CPU collectives WITH multiple virtual CPU devices. Some builds
+    accept the gloo config but then bring the backend up with a single
+    local device (the collectives client ignores the virtual-device
+    count), which deadlocks/fails the 2-process cluster. Returns None when
+    capable, else a skip reason."""
+    code = (
+        "from dsml_tpu.utils.platform import configure_platform\n"
+        "configure_platform('cpu', 2, cpu_collectives='gloo')\n"
+        "import jax\n"
+        "n = jax.local_device_count()\n"
+        "assert n == 2, f'gloo CPU client exposes {n} local device(s), need 2'\n"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": ""}
+    env.pop("XLA_FLAGS", None)  # the worker starts from a clean flag slate
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))), env=env,
+    )
+    if proc.returncode == 0:
+        return None
+    tail = (proc.stderr or proc.stdout).strip().splitlines()
+    return f"gloo CPU collectives unavailable on this jax build: {tail[-1] if tail else 'probe died'}"
+
+
 # no pytest-timeout in the image (a timeout mark would be silently inert);
 # the communicate(timeout=240) below is the real guard
 def test_two_process_cluster_psum_and_dp_training():
+    reason = _gloo_capability()
+    if reason is not None:
+        pytest.skip(reason)
     port = _free_port()
     env = {**os.environ, "JAX_PLATFORMS": ""}  # workers configure themselves
     procs = [

@@ -166,6 +166,26 @@ def test_torn_state_zero_fill_continuation(devices8):
     step = make_hybrid_train_step(model, opt, mesh8, attn_impl="ring")
     params, opt_state = init_hybrid(model, opt, mesh8, seed=0)
     params, opt_state, _ = step(params, opt_state, x, y)
+    # the jitted step's outputs carry COMPILER-CHOSEN shardings (XLA may
+    # e.g. shard a declared-replicated leaf over dp), which is fine for the
+    # running job but makes "which pieces died with these devices"
+    # nondeterministic — pin the DECLARED layout back before simulating the
+    # loss, exactly what an elastic runner does before auditing
+    from dsml_tpu.parallel.hybrid import shard_params
+
+    pspecs = model.param_specs()
+    params = shard_params(params, mesh8, pspecs)
+    import optax.tree_utils as otu
+    from jax.sharding import NamedSharding
+
+    param_sh = jax.tree.map(
+        lambda s: NamedSharding(mesh8, s), pspecs, is_leaf=lambda s: isinstance(s, P)
+    )
+    repl = NamedSharding(mesh8, P())
+    opt_state = otu.tree_map_params(
+        opt, lambda l, sh: jax.device_put(l, sh), opt_state, param_sh,
+        transform_non_params=lambda l: jax.device_put(l, repl),
+    )
     ref_wqkv = np.asarray(jax.device_get(params["layers"][0]["attn"]["wqkv"]))
     ref_wpe = np.asarray(jax.device_get(params["wpe"]))
 
