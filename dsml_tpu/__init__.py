@@ -17,6 +17,10 @@ NCCL-style data-parallel pipeline, see ``SURVEY.md``) for real TPU hardware:
 - ``dsml_tpu.checkpoint`` — preemption-safe sharded checkpointing: native
   binary-piece + JSON-manifest format, async atomic commits, resumable
   data iterators (``docs/CHECKPOINT.md``).
+- ``dsml_tpu.obs``       — unified observability: metrics registry
+  (counters/gauges/histograms, Prometheus + JSONL exposition), span
+  tracing (Chrome trace-event export), step-time breakdown and
+  goodput/MFU accounting (``docs/OBSERVABILITY.md``).
 - ``dsml_tpu.utils``     — config, logging, metrics, tracing, and the
   checkpoint compat front-end (``utils.checkpoint.Checkpointer``).
 
@@ -38,7 +42,7 @@ _compat.install()
 # Lazy subpackage access keeps the heavy subpackages (models, comm, …) out
 # of the import path until used.
 _SUBPACKAGES = ("ops", "parallel", "models", "comm", "runtime", "utils", "cli",
-                "checkpoint")
+                "checkpoint", "obs")
 
 
 def __getattr__(name):

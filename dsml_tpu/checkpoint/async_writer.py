@@ -17,10 +17,20 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
+
+from dsml_tpu.obs import get_registry
 
 
 class AsyncWriter:
-    """Single-threaded FIFO job runner with sticky first-error propagation."""
+    """Single-threaded FIFO job runner with sticky first-error propagation.
+
+    Observability (``docs/OBSERVABILITY.md``; no-op unless the registry is
+    enabled): ``checkpoint_queue_depth`` gauge (jobs waiting + running),
+    ``checkpoint_commit_ms`` histogram (per-job wall), and
+    ``checkpoint_errors_total`` counter (background failures held for the
+    caller — the sticky-error path is otherwise invisible until the next
+    ``save``)."""
 
     def __init__(self, name: str = "ckpt-writer"):
         self._name = name
@@ -31,6 +41,14 @@ class AsyncWriter:
         self._error: BaseException | None = None
         self._thread: threading.Thread | None = None
         self._closed = False
+        self._obs = get_registry()
+
+    def _note_depth(self) -> None:
+        # caller holds self._lock
+        self._obs.gauge(
+            "checkpoint_queue_depth", "async checkpoint jobs pending",
+            labels=("writer",),
+        ).set(len(self._jobs) + (1 if self._busy else 0), writer=self._name)
 
     def submit(self, fn) -> None:
         """Queue ``fn()`` for background execution; raises any held error
@@ -40,6 +58,7 @@ class AsyncWriter:
             if self._closed:
                 raise RuntimeError("AsyncWriter is closed")
             self._jobs.append(fn)
+            self._note_depth()
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
                     target=self._run, name=self._name, daemon=True
@@ -56,15 +75,27 @@ class AsyncWriter:
                     return  # closed and drained
                 fn = self._jobs.popleft()
                 self._busy = True
+                self._note_depth()
+            t0 = time.perf_counter()
             try:
                 fn()
             except BaseException as e:  # noqa: BLE001 — held for the caller
+                self._obs.counter(
+                    "checkpoint_errors_total",
+                    "background checkpoint commit failures (held sticky)",
+                    labels=("writer",),
+                ).inc(writer=self._name)
                 with self._lock:
                     if self._error is None:
                         self._error = e
             finally:
+                self._obs.histogram(
+                    "checkpoint_commit_ms", "background commit wall time",
+                    labels=("writer",),
+                ).observe((time.perf_counter() - t0) * 1e3, writer=self._name)
                 with self._lock:
                     self._busy = False
+                    self._note_depth()
                     self._idle.notify_all()
 
     def check_error(self) -> None:

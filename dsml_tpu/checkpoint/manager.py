@@ -25,6 +25,7 @@ from typing import Any
 
 from dsml_tpu.checkpoint import native
 from dsml_tpu.checkpoint.async_writer import AsyncWriter
+from dsml_tpu.obs import get_registry
 from dsml_tpu.utils.logging import get_logger
 
 log = get_logger("checkpoint")
@@ -67,6 +68,10 @@ class CheckpointManager:
             self._gc()
 
         self._writer.submit(job)
+        get_registry().counter(
+            "checkpoint_saves_total", "checkpoint save submissions",
+            labels=("mode",),
+        ).inc(mode="sync" if wait else "async")
         if wait:
             self._writer.wait()
             log.info("saved checkpoint step %d -> %s", step, directory)
@@ -92,7 +97,14 @@ class CheckpointManager:
                 shutil.rmtree(trash)
             except OSError:  # already gone (concurrent GC) — fine
                 continue
-            log.info("garbage-collected checkpoint step %d", step)
+            # a silent deletion is how a "lost" checkpoint becomes a
+            # mystery: every GC names the step AND the path it removed,
+            # through both the logger and the registry
+            get_registry().counter(
+                "checkpoint_gc_total", "max_to_keep checkpoint deletions",
+            ).inc()
+            log.info("garbage-collected checkpoint step %d (%s), max_to_keep=%d",
+                     step, path, self.max_to_keep)
 
     # -- read -------------------------------------------------------------
 

@@ -37,6 +37,7 @@ import numpy as np
 from dsml_tpu.comm import rpc
 from dsml_tpu.comm.device_server import DeviceError, local_device
 from dsml_tpu.comm.proto import gpu_sim_pb2 as pb
+from dsml_tpu.obs import get_registry, observe_collective_latency_ms
 from dsml_tpu.ops.collectives import ReduceOp, make_stacked_all_reduce
 from dsml_tpu.utils.config import Config, field as cfg_field
 from dsml_tpu.utils.logging import get_logger
@@ -269,8 +270,16 @@ class CoordinatorRuntime:
                 comm.queued.append(run)
                 return
             comm.in_flight += 1
+        t0 = time.perf_counter()
         try:
             run()
+            # per-op latency, labeled by the algorithm that actually ran —
+            # the accounting surface the reference reported as totalTimeMs
+            observe_collective_latency_ms(
+                self.config.ring_algorithm,
+                (time.perf_counter() - t0) * 1e3,
+                payload_bytes=count, axis="wire",
+            )
         finally:
             with comm.lock:
                 comm.in_flight -= 1
@@ -483,6 +492,9 @@ class CoordinatorRuntime:
         total_ms = int((time.monotonic() - start) * 1000)
         total_bytes = 2 * len(comm.devices) * data_size
         comm.status = pb.SUCCESS
+        observe_collective_latency_ms(
+            "naive", float(total_ms), payload_bytes=total_bytes, axis="wire"
+        )
         log.info("NaiveAllReduce: %d ms, %d bytes", total_ms, total_bytes)
         return total_ms, total_bytes
 
@@ -505,6 +517,15 @@ class CoordinatorRuntime:
                 alive.append(info)
             except grpc.RpcError:
                 failed.append(info)
+        # per-probe outcome counts (matching the reference's health loop,
+        # now queryable instead of log-only)
+        probes = get_registry().counter(
+            "coordinator_health_probes_total", "device health-probe outcomes",
+            labels=("outcome",),
+        )
+        probes.inc(len(alive), outcome="alive")
+        if failed:
+            probes.inc(len(failed), outcome="failed")
         if failed:
             if self.config.elastic and alive:
                 # Elastic recovery: shrink the ring and keep going — the

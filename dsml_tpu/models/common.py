@@ -11,7 +11,41 @@ import numpy as np
 __all__ = [
     "he_init", "softmax_xent", "count_correct", "with_fsdp", "fsdp_spec_fn",
     "quantize_weights_int8", "maybe_dequant",
+    "transformer_train_flops", "mlp_train_flops",
 ]
+
+
+def transformer_train_flops(cfg, n_tokens: int, seq: int,
+                            gated_mlp: bool = False) -> int:
+    """Analytic matmul FLOPs for ONE training step over ``n_tokens`` tokens
+    at sequence length ``seq`` — the PaLM-appendix accounting (fwd matmuls
+    + causal attention term; bwd = 2×fwd; remat recompute NOT counted).
+    This is the single FLOP numerator behind every MFU the bench and
+    ``obs.step_stats`` report, kept here so model families cannot drift
+    apart in their accounting.
+
+    ``cfg`` needs ``n_layer / n_head / d_model / d_ff / vocab_size``;
+    GQA shrinks the k/v projections via ``n_kv_head`` when present.
+    ``gated_mlp=True`` counts the 3-matmul SwiGLU form (Llama), else the
+    2-matmul in/out form (GPT-2)."""
+    T = int(n_tokens)
+    d, ff, L, V = cfg.d_model, cfg.d_ff, cfg.n_layer, cfg.vocab_size
+    kv_frac = getattr(cfg, "n_kv_head", cfg.n_head) / cfg.n_head
+    mlp_mats = 3 if gated_mlp else 2
+    fwd = L * (
+        2 * T * d * d                       # q projection
+        + int(2 * 2 * T * d * d * kv_frac)  # k and v projections (GQA-shrunk)
+        + 2 * T * d * d                     # attention output projection
+        + 2 * 2 * T * seq * d // 2          # q·kᵀ and p·v, causal halves the area
+        + mlp_mats * 2 * T * d * ff         # MLP matmuls
+    ) + 2 * T * d * V                       # unembedding
+    return 3 * fwd
+
+
+def mlp_train_flops(n_params: int, n_samples: int) -> int:
+    """The dense-MLP rule the reference baseline is scored by: 6 FLOPs per
+    parameter per sample (fwd 2 + bwd 4)."""
+    return 6 * int(n_params) * int(n_samples)
 
 # transformer-block matmul weights both families contract on AXIS 0 —
 # the per-output-channel absmax scale is therefore max|w| over axis 0

@@ -1,0 +1,137 @@
+"""dsml_tpu.obs — unified observability: metrics, spans, goodput/MFU.
+
+One subsystem for the accounting the reference coordinator treated as its
+core product (device health, per-algorithm all-reduce latency) and the
+accounting a production TPU trainer actually needs (step-time breakdown,
+goodput across preemptions, MFU):
+
+- :mod:`~dsml_tpu.obs.registry` — process-wide thread-safe metrics
+  registry (counters / gauges / fixed-bound histograms, labeled), JSONL +
+  Prometheus-text exposition. DISABLED by default (``DSML_OBS=1`` or
+  :func:`enable` turns it on); disabled writes cost one branch.
+- :mod:`~dsml_tpu.obs.spans` — nestable host-side span tracer with
+  ``block_until_ready`` fencing, Chrome trace-event JSON export, per-span
+  p50/p90 summaries.
+- :mod:`~dsml_tpu.obs.step_stats` — per-step phase breakdown, goodput
+  (productive ÷ wall across preemption/restore), MFU from
+  ``models.common`` FLOP estimates.
+- :mod:`~dsml_tpu.obs.export` — rotation-safe JSONL sink
+  (:class:`MetricsLogger`) + opt-in HTTP ``/metrics`` endpoint.
+
+Metric names, label sets, and the span taxonomy are specified in
+``docs/OBSERVABILITY.md``.
+"""
+
+from __future__ import annotations
+
+from dsml_tpu.obs.export import (  # noqa: F401
+    MetricsLogger,
+    MetricsServer,
+    start_metrics_server,
+)
+from dsml_tpu.obs.registry import (  # noqa: F401
+    DEFAULT_LATENCY_BUCKETS_MS,
+    Counter,
+    Gauge,
+    Histogram,
+    ObsUnavailable,
+    Registry,
+    disable,
+    enable,
+    enabled,
+    get_registry,
+)
+from dsml_tpu.obs.spans import SpanTracer, get_tracer, span  # noqa: F401
+from dsml_tpu.obs.step_stats import (  # noqa: F401
+    STEP_PHASES,
+    GoodputTracker,
+    StepBreakdown,
+    mfu,
+)
+
+__all__ = [
+    "Registry", "Counter", "Gauge", "Histogram", "ObsUnavailable",
+    "get_registry", "enable", "disable", "enabled",
+    "DEFAULT_LATENCY_BUCKETS_MS",
+    "SpanTracer", "span", "get_tracer",
+    "StepBreakdown", "GoodputTracker", "mfu", "STEP_PHASES",
+    "MetricsLogger", "MetricsServer", "start_metrics_server",
+    "record_collective_plan", "observe_collective_latency_ms",
+]
+
+
+def record_collective_plan(algorithm: str, tree, bucket_size_mb,
+                           axis: str = "dp",
+                           registry: Registry | None = None) -> None:
+    """Record a gradient-sync bucket plan's shape (bucket count, per-bucket
+    and total bytes) labeled by collective algorithm + mesh axis.
+
+    Called from INSIDE step builders at trace time: shapes/dtypes are
+    static there, so this runs once per compilation — never per step —
+    and costs nothing while tracing is the price already being paid.
+    ``bucket_size_mb=None`` records ONE bucket of the tree's total bytes
+    — the dp/hybrid single-buffer ``ravel_pytree`` path (raw leaf bytes;
+    its dtype promotion is not modeled). Callers whose ``None`` means
+    per-dtype buckets (zero2) resolve it to ``float("inf")`` first."""
+    reg = registry if registry is not None else get_registry()
+    if not reg.enabled:
+        return
+    if bucket_size_mb is None:
+        import math
+
+        import jax
+        import jax.numpy as jnp
+
+        sizes = [sum(
+            math.prod(l.shape) * jnp.dtype(jnp.result_type(l)).itemsize
+            for l in jax.tree.leaves(tree)
+        )]
+        n_buckets = 1
+    else:
+        from dsml_tpu.parallel.bucketing import plan_buckets
+
+        plan = plan_buckets(tree, bucket_size_mb)
+        sizes = [plan.bucket_nbytes(b) for b in range(plan.n_buckets)]
+        n_buckets = plan.n_buckets
+    labels = {"algorithm": algorithm, "axis": axis}
+    reg.counter(
+        "collective_sync_compiles_total",
+        "gradient-sync step compilations", labels=("algorithm", "axis"),
+    ).inc(**labels)
+    reg.gauge(
+        "collective_sync_buckets",
+        "buckets per gradient sync", labels=("algorithm", "axis"),
+    ).set(n_buckets, **labels)
+    reg.gauge(
+        "collective_sync_bytes",
+        "total gradient bytes per sync", labels=("algorithm", "axis"),
+    ).set(sum(sizes), **labels)
+    hist = reg.histogram(
+        "collective_bucket_bytes",
+        "per-bucket payload bytes", labels=("algorithm", "axis"),
+        buckets=(1 << 16, 1 << 18, 1 << 20, 1 << 22, 1 << 24, 1 << 26, 1 << 28),
+    )
+    for nbytes in sizes:
+        hist.observe(nbytes, **labels)
+
+
+def observe_collective_latency_ms(algorithm: str, ms: float,
+                                  payload_bytes: int | None = None,
+                                  axis: str = "dp",
+                                  registry: Registry | None = None) -> None:
+    """One measured collective latency sample →
+    ``collective_latency_ms{algorithm,axis}`` (the EQuARX-style
+    per-algorithm accounting surface; ``utils.tracing.ring_latency_ms``
+    and ``bench.py --section obs`` feed it)."""
+    reg = registry if registry is not None else get_registry()
+    if not reg.enabled:
+        return
+    reg.histogram(
+        "collective_latency_ms",
+        "measured all-reduce latency", labels=("algorithm", "axis"),
+    ).observe(ms, algorithm=algorithm, axis=axis)
+    if payload_bytes is not None:
+        reg.counter(
+            "collective_latency_sampled_bytes_total",
+            "payload bytes of measured collectives", labels=("algorithm", "axis"),
+        ).inc(payload_bytes, algorithm=algorithm, axis=axis)

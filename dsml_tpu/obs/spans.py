@@ -1,0 +1,152 @@
+"""Nestable span tracing with Chrome trace-event export.
+
+``jax.profiler`` captures device timelines but needs a working profiler
+backend (see ``utils.tracing.trace``); these spans are the host-side
+complement: cheap, dependency-free wall-clock intervals around the
+phases a training/serving loop actually schedules (data fetch, step
+dispatch, grad sync, checkpoint stall). Spans nest through a
+thread-local stack, optionally FENCE on device values before closing
+(``fence=`` pytree → ``block_until_ready``, so a span around a jitted
+call measures execution, not dispatch), and export two ways:
+
+- :meth:`SpanTracer.chrome_trace` — Chrome trace-event JSON (duration
+  ``B``/``E`` pairs, microsecond ``ts``), loadable in ``chrome://tracing``
+  / Perfetto;
+- :meth:`SpanTracer.summaries` — per-span-name count/p50/p90 (ms),
+  backed by the registry histogram ``span_ms{name=...}``.
+
+Disabled-registry runs pay one branch per span and record nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+
+from dsml_tpu.obs.registry import Registry, get_registry
+
+__all__ = ["SpanTracer", "span", "get_tracer"]
+
+# cap on retained trace events (B+E pairs): a week-long run must not grow
+# host memory; the newest events win because the deque drops oldest first
+_EVENT_CAP = 200_000
+
+
+class SpanTracer:
+    """Collects spans into trace events + a per-name latency histogram."""
+
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry if registry is not None else get_registry()
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self._hist = self.registry.histogram(
+            "span_ms", "host-side span durations", labels=("name",)
+        )
+
+    # perf_counter is monotonic and sub-µs; one common origin per tracer so
+    # every event's ts is comparable
+    _t0 = time.perf_counter()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    @contextlib.contextmanager
+    def span(self, name: str, fence=None, **args):
+        """Trace ``name`` around the block. ``fence``: a jax array/pytree to
+        ``block_until_ready`` before the span closes (measure execution, not
+        dispatch). Extra kwargs land in the event's ``args``. Nesting is
+        carried by B/E event order per thread, matching Chrome's duration-
+        event semantics."""
+        if not self.registry.enabled:
+            yield self
+            return
+        tid = threading.get_ident()
+        begin = {
+            "name": name, "ph": "B", "ts": self._now_us(),
+            "pid": 0, "tid": tid,
+        }
+        if args:
+            begin["args"] = {k: str(v) for k, v in args.items()}
+        with self._lock:
+            self._append(begin)
+        try:
+            yield self
+        finally:
+            if fence is not None:
+                import jax
+
+                jax.block_until_ready(fence)
+            end_ts = self._now_us()
+            with self._lock:
+                self._append({"name": name, "ph": "E", "ts": end_ts,
+                              "pid": 0, "tid": tid})
+            self._hist.observe((end_ts - begin["ts"]) / 1e3, name=name)
+
+    def _append(self, event: dict) -> None:
+        self._events.append(event)
+        if len(self._events) > _EVENT_CAP:
+            # amortized eviction: cut the oldest quarter, then drop 'E'
+            # events whose 'B' fell in the cut — orphaned ends would make
+            # chrome://tracing mis-nest the whole remaining trace. (Old B
+            # events whose E survives stay matched; only E-without-B can
+            # result from dropping a prefix.)
+            del self._events[: _EVENT_CAP // 4]
+            kept, stacks = [], {}
+            for e in self._events:
+                stack = stacks.setdefault(e["tid"], [])
+                if e["ph"] == "B":
+                    stack.append(e["name"])
+                elif e["ph"] == "E":
+                    if not stack or stack[-1] != e["name"]:
+                        continue  # its B was evicted — drop the orphan
+                    stack.pop()
+                kept.append(e)
+            self._events = kept
+
+    # -- export ------------------------------------------------------------
+
+    def chrome_trace(self) -> dict:
+        """``chrome://tracing``-loadable dict. Events are sorted by ``ts``
+        (concurrent threads append under one lock, but their clock reads
+        race the append order)."""
+        with self._lock:
+            events = sorted(self._events, key=lambda e: e["ts"])
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def save_chrome_trace(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+    def summaries(self) -> dict:
+        """{span name: {count, mean, p50, p90, p99} (ms)}."""
+        out = {}
+        for key, _ in self._hist._items():
+            (name,) = key
+            out[name] = self._hist.summary(name=name)
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+_default_tracer: SpanTracer | None = None
+_default_lock = threading.Lock()
+
+
+def get_tracer() -> SpanTracer:
+    """The process-default tracer (bound to the default registry)."""
+    global _default_tracer
+    if _default_tracer is None:
+        with _default_lock:
+            if _default_tracer is None:
+                _default_tracer = SpanTracer()
+    return _default_tracer
+
+
+def span(name: str, fence=None, **args):
+    """``with obs.span("grad_sync"): ...`` against the default tracer."""
+    return get_tracer().span(name, fence=fence, **args)

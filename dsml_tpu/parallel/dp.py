@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dsml_tpu.obs import record_collective_plan
 from dsml_tpu.ops.collectives import ReduceOp
 from dsml_tpu.parallel.bucketing import bucketed_all_reduce, default_bucket_mb
 
@@ -80,6 +81,9 @@ def make_dp_train_step(
         def compute_grads(params, x, y):
             def shard_fn(params, x, y):
                 loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+                # trace-time (static shapes): records bucket count/bytes
+                # once per compile, labeled by algorithm — zero cost per step
+                record_collective_plan(algorithm, grads, bucket_size_mb, axis)
                 grads = bucketed_all_reduce(
                     grads, axis, ReduceOp.AVG, algorithm, bucket_size_mb
                 )

@@ -29,6 +29,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dsml_tpu.obs import record_collective_plan
 from dsml_tpu.ops.collectives import ReduceOp, flat_all_gather, flat_reduce_scatter
 from dsml_tpu.parallel.bucketing import (
     _leaf_size,
@@ -285,6 +286,11 @@ def make_zero2_train_step(
     def step(params, opt_state, x, y):
         plan = plan_buckets(params, plan_mb)
         specs = _opt_specs(opt_state, axis)
+        # trace-time: the ZeRO-2 reduce-scatter plan, labeled "zero2" next
+        # to the dp algorithms in the same registry metrics (None means
+        # per-dtype buckets HERE, unlike dp's single ravel buffer — pass
+        # the resolved plan_mb so the recorder models what actually runs)
+        record_collective_plan("zero2", params, plan_mb, axis)
 
         def shard_fn(params, opt_state, x, y):
             loss, grads = jax.value_and_grad(loss_fn)(params, x, y)

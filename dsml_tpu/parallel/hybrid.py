@@ -245,6 +245,10 @@ def make_hybrid_train_step(
                 grads = jax.tree.map(lambda g: g / grad_accum, grads)
             # the step's ONLY cross-rank exchange: per-bucket collectives,
             # once per step regardless of grad_accum
+            from dsml_tpu.obs import record_collective_plan
+
+            # trace-time: bucket plan labeled by algorithm, once per compile
+            record_collective_plan(dp_sync, grads, mb, "dp")
             grads = bucketed_all_reduce(grads, "dp", ReduceOp.AVG, dp_sync, mb)
             return lax.pmean(loss, "dp"), grads
 

@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dsml_tpu.obs import get_registry
+
 __all__ = ["Request", "ContinuousBatcher"]
 
 
@@ -228,6 +230,7 @@ class ContinuousBatcher:
         # decode mask (>= 0) nor the free-slot scan (== -1) touches it
         self._pending = None
 
+        self._obs = get_registry()  # no-op unless observability is enabled
         self._queue: deque[Request] = deque()
         self._live: dict[int, Request] = {}  # queued or in a slot
         self._done: dict[int, Request] = {}  # retired, awaiting collect()
@@ -714,6 +717,12 @@ class ContinuousBatcher:
         tok = self._sample(np.asarray(logits_row), req)
         req.tokens.append(tok)
         req.first_token_at = time.monotonic()
+        if self._obs.enabled:
+            # admission latency = queue wait + prefill: the serving-side
+            # TTFT, as a histogram the /metrics endpoint can expose live
+            self._obs.histogram(
+                "serving_admission_ms", "submit→first-token latency",
+            ).observe((req.first_token_at - req.submitted_at) * 1e3)
         emitted[req.rid] = [tok]
         if self._finished(req, tok):
             self._retire(req)
@@ -894,6 +903,19 @@ class ContinuousBatcher:
         over-decoded lane-ticks are the quantum's scheduling cost)."""
         emitted = self._step_inner()
         self._note_emissions(emitted)
+        if self._obs.enabled:
+            # batch occupancy per tick: the utilization signal behind
+            # "should this deployment raise n_slots"
+            self._obs.histogram(
+                "serving_slot_occupancy", "active slots / n_slots per tick",
+                buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+            ).observe(self.n_active / self.n_slots)
+            self._obs.gauge(
+                "serving_queue_depth", "requests waiting for a slot",
+            ).set(self.n_queued)
+            self._obs.counter(
+                "serving_tokens_total", "tokens emitted",
+            ).inc(sum(len(t) for t in emitted.values()))
         return emitted
 
     def _step_inner(self) -> dict[int, list]:

@@ -17,12 +17,13 @@ import jax.numpy  # noqa: F401 (used via jax.numpy.array in warm-start copy)
 import numpy as np
 import optax
 
+from dsml_tpu.obs import GoodputTracker, StepBreakdown, get_registry
 from dsml_tpu.parallel.dp import make_dp_train_step, make_eval_step
 from dsml_tpu.parallel.mesh import data_mesh
 from dsml_tpu.utils.config import Config, field
 from dsml_tpu.utils.data import Dataset, prefetch_batches, shard_batches
 from dsml_tpu.utils.logging import get_logger
-from dsml_tpu.utils.metrics import EpochMetrics, MetricsLogger
+from dsml_tpu.utils.metrics import EpochMetrics, MetricsLogger, ProgressBar
 
 log = get_logger("trainer")
 
@@ -49,43 +50,12 @@ class TrainConfig(Config):
     progress: bool = field(False, help="draw per-epoch train/eval progress bars on stderr (reference client UX)")
 
 
-class _ProgressBar:
-    """Minimal in-place stderr bar matching the reference client's
-    schollz/progressbar UX (per-epoch training bar
-    ``DSML/client/client.go:584-590``, test bar ``client.go:467-473``).
-    Off unless ``TrainConfig.progress`` — a redraw per batch is host-side
-    noise the compiled step loop doesn't need by default."""
-
-    def __init__(self, total: int, label: str, enabled: bool, width: int = 30):
-        import sys
-
-        self.total = max(total, 1)
-        self.label = label
-        self.enabled = enabled  # draws even when piped, like the reference's bar
-        self.width = width
-        self.n = 0
-        self._last_cells = -1
-        self._err = sys.stderr
-
-    def update(self, k: int = 1) -> None:
-        if not self.enabled:
-            return
-        self.n = min(self.n + k, self.total)
-        cells = self.n * self.width // self.total
-        if cells == self._last_cells and self.n != self.total:
-            return  # redraw only when the bar visibly moves
-        self._last_cells = cells
-        pct = 100 * self.n // self.total
-        bar = "█" * cells + " " * (self.width - cells)
-        self._err.write(f"\r{self.label} {pct:3d}% |{bar}| ({self.n}/{self.total})")
-        self._err.flush()
-
-    def close(self) -> None:
-        if self.enabled:
-            if self.n < self.total:
-                self.update(self.total - self.n)
-            self._err.write("\n")
-            self._err.flush()
+# The per-epoch bar is ``utils.metrics.ProgressBar`` (the reference
+# client's schollz/progressbar UX, client.go:584-590/467-473): TTY-aware
+# — in-place redraws on an interactive stderr, one newline-terminated
+# summary line per bar otherwise — and off unless ``TrainConfig.progress``
+# (a redraw per batch is host-side noise the compiled step loop doesn't
+# need by default).
 
 
 def _make_optimizer(cfg: TrainConfig, steps_per_epoch: int) -> optax.GradientTransformation:
@@ -178,6 +148,20 @@ class Trainer:
                 start_epoch = int(state["meta"]["epoch"]) + 1
                 log.info("resumed from checkpoint at epoch %d", start_epoch - 1)
 
+        # Observability (docs/OBSERVABILITY.md): when the registry is
+        # enabled, the loop records a per-step breakdown (data /
+        # step_dispatch / loss_sync / checkpoint_stall — the fused jitted
+        # step is one program, so fwd-bwd/sync/opt split lives in
+        # `bench.py --section obs`) and goodput = productive step time ÷
+        # wall across resume/checkpoint events. Disabled: one boolean per
+        # step, nothing recorded.
+        obs_reg = get_registry()
+        track = obs_reg.enabled
+        goodput = GoodputTracker(registry=obs_reg) if track else None
+        breakdown = StepBreakdown(registry=obs_reg) if track else None
+        if track and start_epoch > 1:
+            goodput.mark("restore", epoch=start_epoch - 1)
+
         history = []
         t0 = time.monotonic()
         for epoch in range(start_epoch, cfg.epochs + 1):
@@ -188,15 +172,33 @@ class Trainer:
             batches = prefetch_batches(
                 shard_batches(data.train_x, data.train_y, cfg.batch_size, seed=cfg.seed + epoch)
             )
-            bar = _ProgressBar(steps_per_epoch, f"Epoch {epoch}/{cfg.epochs}",
-                               cfg.progress)
+            bar = ProgressBar(steps_per_epoch, desc=f"Epoch {epoch}/{cfg.epochs}",
+                              enabled=cfg.progress)
+            epoch_t0 = time.monotonic()
+            t_prev = time.perf_counter()
             for x, y in batches:
+                if track:
+                    t_data = time.perf_counter()
+                    breakdown.add("data", t_data - t_prev)
                 params, opt_state, loss = self._step_fn(params, opt_state, x, y)
+                if track:
+                    t_disp = time.perf_counter()
+                    breakdown.add("step_dispatch", t_disp - t_data)
                 losses.append(loss)
                 bar.update()
                 if len(losses) % sync_every == 0:
                     losses[-1].block_until_ready()
+                    if track:
+                        breakdown.add("loss_sync", time.perf_counter() - t_disp)
+                if track:
+                    now = time.perf_counter()
+                    breakdown.note_step_wall(now - t_prev)
+                    t_prev = now
             bar.close()
+            if track:
+                # productive = time spent driving steps; eval/logging/
+                # checkpoint overhead shows up as the goodput gap
+                goodput.add_productive(time.monotonic() - epoch_t0)
             em = EpochMetrics()
             for loss in losses:
                 em.update(float(loss), 0, cfg.batch_size)
@@ -213,11 +215,19 @@ class Trainer:
                 # the NEXT epoch's seed — shard_batches re-derives the
                 # shuffle from (cfg.seed + epoch), making resume
                 # bit-identical to the uninterrupted run
+                t_save = time.perf_counter()
                 ckpt.save(epoch,
                           {"params": params, "opt_state": opt_state,
                            "meta": {"epoch": epoch}},
                           iterator_state={"epoch": epoch, "consumed": 0},
                           wait=False)
+                if track:
+                    # what the step loop actually paid: the synchronous
+                    # host snapshot + enqueue (the commit rides the writer
+                    # thread and surfaces as checkpoint_commit_ms)
+                    breakdown.add("checkpoint_stall",
+                                  time.perf_counter() - t_save)
+                    goodput.mark("checkpoint_save", epoch=epoch)
         last_epoch = cfg.epochs
         if ckpt is not None:
             # final state must always be persisted, even when epochs isn't a
@@ -237,9 +247,15 @@ class Trainer:
         epochs_run = max(cfg.epochs - start_epoch + 1, 0)  # resume skips earlier epochs
         samples = epochs_run * steps_per_epoch * cfg.batch_size
         log.info("Final Test Accuracy: %.2f%%", test_acc * 100)  # client.go:500-501 shape
-        self.metrics.log(
-            test_accuracy=test_acc, wall_time_s=wall, samples_per_sec=samples / max(wall, 1e-9)
-        )
+        final = {"test_accuracy": test_acc, "wall_time_s": wall,
+                 "samples_per_sec": samples / max(wall, 1e-9)}
+        if track:
+            gsum = goodput.summary()
+            obs_reg.gauge("train_goodput", "productive/wall of the last run") \
+                .set(gsum["goodput"])
+            final["obs_goodput"] = gsum
+            final["obs_step_breakdown"] = breakdown.summary()
+        self.metrics.log(**final)
         return params, history, test_acc
 
     def evaluate(self, params, x: np.ndarray, y: np.ndarray, batch_size: int = 2048,
@@ -248,8 +264,9 @@ class Trainer:
         n = x.shape[0]
         usable = n - (n % n_dp)  # each eval batch must split evenly over dp
         bs = max(batch_size - batch_size % n_dp, n_dp)
-        bar = _ProgressBar((usable + bs - 1) // bs, progress_label or "Testing",
-                           progress_label is not None)
+        bar = ProgressBar((usable + bs - 1) // bs,
+                          desc=progress_label or "Testing",
+                          enabled=progress_label is not None)
         correct = 0
         for start in range(0, usable, bs):
             xb, yb = x[start : start + bs], y[start : start + bs]

@@ -4,26 +4,28 @@ The reference reports per-epoch average loss and accuracy
 (``DSML/client/client.go:650-652``), a final test accuracy (``:500-501``), and
 draws per-epoch terminal progress bars via schollz/progressbar
 (``client.go:584-590``; SURVEY.md §5.5). ``EpochMetrics``/``ProgressBar``
-reproduce that surface; ``MetricsLogger`` adds the structured record the
-reference lacked (JSON-lines history usable by tests and benchmarks).
+reproduce that surface. ``MetricsLogger`` — the structured JSON-lines
+record the reference lacked — now lives in the observability subsystem
+(``dsml_tpu.obs.export``, where it gained size-capped rotation) and is
+re-exported here so existing imports keep working.
 """
 
 from __future__ import annotations
 
-import json
 import sys
 import time
-from dataclasses import dataclass, field
+
+from dsml_tpu.obs.export import MetricsLogger  # noqa: F401 — compat re-export
 
 
-@dataclass
 class EpochMetrics:
     """Running mean loss + accuracy over one epoch."""
 
-    loss_sum: float = 0.0
-    correct: int = 0
-    seen: int = 0
-    batches: int = 0
+    def __init__(self):
+        self.loss_sum = 0.0
+        self.correct = 0
+        self.seen = 0
+        self.batches = 0
 
     def update(self, loss: float, correct: int, batch_size: int) -> None:
         self.loss_sum += float(loss)
@@ -48,23 +50,40 @@ class EpochMetrics:
 
 
 class ProgressBar:
-    """Minimal terminal progress bar (stand-in for schollz/progressbar)."""
+    """Minimal terminal progress bar (stand-in for schollz/progressbar).
 
-    def __init__(self, total: int, desc: str = "", width: int = 30, stream=None, enabled: bool | None = None):
+    TTY-aware: on an interactive stream it redraws in place with ``\\r``;
+    on a non-interactive stream (pytest, CI logs, piped output) it stays
+    silent until the bar completes/closes, then emits ONE newline-
+    terminated summary line — line-per-epoch logs instead of a wall of
+    carriage returns. ``enabled=False`` silences it entirely."""
+
+    def __init__(self, total: int, desc: str = "", width: int = 30, stream=None,
+                 enabled: bool | None = None):
         self.total = max(total, 1)
         self.desc = desc
         self.width = width
         self.n = 0
         self.stream = stream or sys.stderr
-        self.enabled = self.stream.isatty() if enabled is None else enabled
+        self.enabled = True if enabled is None else enabled
+        self.interactive = bool(getattr(self.stream, "isatty", lambda: False)())
         self._t0 = time.monotonic()
+        self._summarized = False
+        self._last_filled = -1
 
     def update(self, k: int = 1) -> None:
         self.n += k
         if not self.enabled:
             return
         frac = min(self.n / self.total, 1.0)
+        if not self.interactive:
+            if frac >= 1.0:
+                self._summary_line()
+            return
         filled = int(frac * self.width)
+        if filled == self._last_filled and frac < 1.0:
+            return  # redraw only when the bar visibly moves (host-side noise)
+        self._last_filled = filled
         bar = "=" * filled + ">" + " " * (self.width - filled)
         rate = self.n / max(time.monotonic() - self._t0, 1e-9)
         self.stream.write(f"\r{self.desc} [{bar}] {self.n}/{self.total} ({rate:.0f}/s)")
@@ -72,29 +91,19 @@ class ProgressBar:
             self.stream.write("\n")
         self.stream.flush()
 
+    def _summary_line(self) -> None:
+        if self._summarized:
+            return
+        self._summarized = True
+        rate = self.n / max(time.monotonic() - self._t0, 1e-9)
+        self.stream.write(f"{self.desc} {self.n}/{self.total} ({rate:.0f}/s)\n")
+        self.stream.flush()
+
     def close(self) -> None:
-        if self.enabled and self.n < self.total:
+        if not self.enabled:
+            return
+        if not self.interactive:
+            self._summary_line()
+        elif self.n < self.total:
             self.stream.write("\n")
             self.stream.flush()
-
-
-class MetricsLogger:
-    """Append-only JSON-lines metrics history with wall-clock timestamps."""
-
-    def __init__(self, path: str | None = None):
-        self.path = path
-        self.records: list[dict] = []
-
-    def log(self, **kv) -> dict:
-        rec = {"time": time.time(), **kv}
-        self.records.append(rec)
-        if self.path:
-            with open(self.path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
-        return rec
-
-    def last(self, **match) -> dict | None:
-        for rec in reversed(self.records):
-            if all(rec.get(k) == v for k, v in match.items()):
-                return rec
-        return None

@@ -5,11 +5,18 @@ pairs around the naive all-reduce (SURVEY.md §5.1). Here:
 
 - :func:`trace` — context manager around ``jax.profiler`` producing a
   TensorBoard-loadable XLA trace (per-op device timelines, fusion view).
+  Capture failures on the pinned jax 0.4.37 raise
+  :class:`~dsml_tpu.obs.ObsUnavailable` with remediation text instead of
+  an opaque backend traceback.
 - :func:`time_jitted` — p50/p90 wall latency of an already-jitted callable
-  with proper warmup + ``block_until_ready`` fencing.
+  with proper warmup + ``block_until_ready`` fencing. Samples feed the
+  observability registry (``time_jitted_ms`` histogram) when it is
+  enabled.
 - :func:`ring_latency_ms` — the BASELINE.md headline: p50 latency of the
   2(n-1)-step ring all-reduce at a given payload size, timed as ONE device
-  program (no host staging in the loop).
+  program (no host staging in the loop). Samples feed
+  ``collective_latency_ms{algorithm=...}`` — the same per-algorithm
+  accounting surface ``bench.py --section obs`` populates.
 """
 
 from __future__ import annotations
@@ -20,6 +27,7 @@ from typing import Callable
 
 import numpy as np
 
+from dsml_tpu.obs import ObsUnavailable, get_registry, observe_collective_latency_ms
 from dsml_tpu.utils.logging import get_logger
 
 log = get_logger("tracing")
@@ -27,15 +35,47 @@ log = get_logger("tracing")
 
 @contextlib.contextmanager
 def trace(log_dir: str):
-    """Capture an XLA profiler trace into ``log_dir``."""
+    """Capture an XLA profiler trace into ``log_dir``.
+
+    The pinned jax 0.4.37 can fail the capture in several environment-
+    dependent ways (no profiler backend linked into the CPU wheel, a
+    second concurrent capture, a dead TPU tunnel mid-stop); each surfaces
+    as :class:`ObsUnavailable` naming the fix instead of a raw backend
+    stack."""
     import jax
 
-    jax.profiler.start_trace(log_dir)
+    def _unavailable(stage: str, e: Exception) -> ObsUnavailable:
+        return ObsUnavailable(
+            f"jax.profiler trace {stage} failed on this jax build "
+            f"({jax.__version__}): {e!r}. Remediation: ensure no other "
+            "capture is active, that the backend links a profiler "
+            "(CPU wheels may not), and that the device is reachable; for "
+            "host-side timing that always works, use dsml_tpu.obs.span "
+            "(Chrome trace-event export) instead."
+        )
+
+    try:
+        jax.profiler.start_trace(log_dir)
+    except Exception as e:  # noqa: BLE001 — backend-dependent failure set
+        raise _unavailable("start", e) from e
+    body_failed = False
     try:
         yield log_dir
+    except BaseException:
+        body_failed = True
+        raise
     finally:
-        jax.profiler.stop_trace()
-        log.info("profiler trace written to %s", log_dir)
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            if body_failed:
+                # the body's exception is already propagating — a raise here
+                # would REPLACE it with the (secondary) capture failure
+                log.warning("profiler stop_trace failed during unwind: %r", e)
+            else:
+                raise _unavailable("stop", e) from e
+        else:
+            log.info("profiler trace written to %s", log_dir)
 
 
 def time_jitted(fn: Callable, *args, iters: int = 20, warmup: int = 3) -> dict:
@@ -54,11 +94,17 @@ def time_jitted(fn: Callable, *args, iters: int = 20, warmup: int = 3) -> dict:
         fence(fn(*args))
         samples.append((time.perf_counter() - t0) * 1000.0)
     arr = np.asarray(samples)
+    reg = get_registry()
+    if reg.enabled:
+        hist = reg.histogram("time_jitted_ms", "time_jitted wall samples")
+        for ms in samples:
+            hist.observe(ms)
     return {
         "p50_ms": float(np.percentile(arr, 50)),
         "p90_ms": float(np.percentile(arr, 90)),
         "mean_ms": float(arr.mean()),
         "iters": iters,
+        "samples_ms": [round(s, 6) for s in samples],
     }
 
 
@@ -91,5 +137,9 @@ def ring_latency_ms(mesh, payload_bytes: int = 1 << 20, algorithm: str = "ring")
         jnp.ones((n, elems), jnp.float32), NamedSharding(mesh, spec)
     )
     stats = time_jitted(fn, x)
+    for ms in stats.pop("samples_ms"):
+        observe_collective_latency_ms(
+            algorithm, ms, payload_bytes=payload_bytes, axis=axis
+        )
     stats.update(payload_bytes=payload_bytes, devices=n, algorithm=algorithm)
     return stats

@@ -85,6 +85,43 @@ def test_watchdog_emits_while_probe_hangs():
     assert wall < 100, f"watchdog emit took {wall:.0f}s"
 
 
+def test_obs_section_schema():
+    """The BENCH `obs` section's contract (ISSUE 4 acceptance): per-
+    algorithm collective-latency histograms, a step-time breakdown whose
+    components sum to within 5% of the measured step wall, and the
+    disabled-registry overhead guard. Run in-process — the test conftest
+    already provides the 8-device CPU mesh the section measures on."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    rows = bench.bench_obs()
+
+    # (a) per-algorithm latency histograms: every explicit algorithm has
+    # p50/p90 + sample count, and the cumulative histogram is monotone
+    # with its +Inf bucket equal to the count
+    for alg in ("ring", "ring2", "naive", "q8"):
+        assert f"obs_collective_{alg}_error" not in rows, rows
+        assert rows[f"obs_collective_{alg}_n"] > 0
+        assert rows[f"obs_collective_{alg}_p90_ms"] >= rows[f"obs_collective_{alg}_p50_ms"]
+        hist = rows["obs_collective_latency_hist"][alg]
+        counts = list(hist.values())
+        assert counts == sorted(counts)  # cumulative
+        assert hist["+Inf"] == rows[f"obs_collective_{alg}_n"]
+
+    # (b) step breakdown: the five canonical phases, summing to within 5%
+    # of the measured wall
+    breakdown = rows["obs_step_breakdown_ms"]
+    assert set(breakdown) == {
+        "data", "forward_backward", "grad_sync", "optimizer", "checkpoint_stall"
+    }
+    assert rows["obs_step_wall_ms"] > 0
+    assert rows["obs_step_coverage_pct"] >= 95.0
+
+    # (c) disabled-mode overhead guard: the acceptance bar is < 1% of a
+    # fused step (measured as bundle cost ÷ step time — see bench_obs)
+    assert rows["obs_disabled_overhead_pct"] < 1.0
+
+
 @pytest.mark.slow
 def test_cpu_fallback_emits_under_hung_probe():
     """The capped-preflight path: probe hangs, preflight gives up inside its
