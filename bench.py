@@ -1997,6 +1997,171 @@ def bench_obs() -> dict:
     return out
 
 
+def bench_forensics() -> dict:
+    """Failure-forensics section (``docs/OBSERVABILITY.md`` § Failure
+    forensics), three sub-rows on private obs instances:
+
+    (a) DISABLED overhead guard: the per-step forensic bundle exactly as
+        the trainer wires it when nothing is configured (sentinel branch
+        + hangwatch branch + flight-recorder record on a disabled
+        registry) — cost ÷ fused-step wall must stay under the existing
+        <1% bar (``forensics_disabled_overhead_pct``);
+    (b) ENABLED per-step overhead: the same bundle live (sentinel check +
+        ring append + hangwatch arm/disarm) — also < 1% of a fused step
+        (``forensics_enabled_overhead_pct``);
+    (c) injected-NaN detection latency: the batch goes NaN at step k; a
+        halt-policy sentinel checked at the trainer's sync cadence must
+        trip at the next sync point, leaving a postmortem bundle whose
+        event/file inventory the row reports.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from dsml_tpu import obs
+    from dsml_tpu.obs.sentinels import SentinelConfig, SentinelTripped, TrainingSentinels
+
+    out: dict = {}
+    rng = np.random.default_rng(0)
+    d, batch = 256, 64
+    params = {
+        f"p{i}": jnp.asarray(rng.standard_normal((d, d)), jnp.float32)
+        for i in range(4)
+    }
+    optimizer = optax.adam(1e-3)
+    opt_state = optimizer.init(params)
+    x_host = rng.standard_normal((batch, d)).astype(np.float32)
+
+    def loss_fn(p, xb):
+        h = xb
+        for i in range(4):
+            h = jnp.tanh(h @ p[f"p{i}"])
+        return jnp.mean(h * h)
+
+    def fused(p, o, xb):
+        loss, g = jax.value_and_grad(loss_fn)(p, xb)
+        up, o = optimizer.update(g, o, p)
+        return optax.apply_updates(p, up), o, loss
+
+    fused_fn = jax.jit(fused)
+    xb = jnp.asarray(x_host)
+    p0, o0, loss = fused_fn(params, opt_state, xb)
+    float(loss)
+    _bump_progress()
+
+    def step_wall(k: int = 40) -> float:
+        pp, oo = p0, o0
+        t0 = time.perf_counter()
+        for _ in range(k):
+            pp, oo, ls = fused_fn(pp, oo, xb)
+        float(ls)
+        return (time.perf_counter() - t0) / k
+
+    step_s = min(step_wall() for _ in range(3))
+
+    # (a) disabled bundle: exactly the trainer's per-batch forensic cost
+    # when DSML_SENTINELS/DSML_HANGWATCH are unset and the registry is off
+    reg_off = obs.Registry(enabled=False)
+    rec_off = obs.FlightRecorder(registry=reg_off)
+    sentinels_off = None
+    hw_off = None
+    n_iter = 100_000
+    t0 = time.perf_counter()
+    for i in range(n_iter):
+        if hw_off is not None:
+            pass
+        rec_off.record("step", step=i, wall_ms=0.0)
+        if sentinels_off is not None:
+            pass
+    disabled_s = (time.perf_counter() - t0) / n_iter
+    out["forensics_disabled_bundle_ns"] = round(disabled_s * 1e9, 1)
+    out["forensics_disabled_overhead_pct"] = round(100.0 * disabled_s / step_s, 4)
+    _bump_progress()
+
+    # (b) enabled bundle: sentinel check + ring append + hangwatch
+    # arm/disarm per step, all live on private instances
+    reg_on = obs.Registry(enabled=True)
+    rec_on = obs.FlightRecorder(registry=reg_on)
+    sent = TrainingSentinels(SentinelConfig(), registry=reg_on, recorder=rec_on)
+    hw = obs.HangWatch(registry=reg_on, recorder=rec_on, name="bench-hangwatch")
+    try:
+        n_iter = 2_000
+
+        def enabled_pass(base: int) -> float:
+            t0 = time.perf_counter()
+            for i in range(base, base + n_iter):
+                tok = hw.arm("train_step", 60.0, step=i)
+                rec_on.record("step", step=i, wall_ms=1.0)
+                sent.check(i, 0.5)
+                hw.disarm(tok)
+            return (time.perf_counter() - t0) / n_iter
+
+        # min of 3 passes: scheduler jitter must not manufacture a bar miss
+        enabled_s = min(enabled_pass(r * n_iter) for r in range(3))
+    finally:
+        hw.close()
+    out["forensics_enabled_bundle_us"] = round(enabled_s * 1e6, 2)
+    out["forensics_enabled_overhead_pct"] = round(100.0 * enabled_s / step_s, 4)
+    out["forensics_step_wall_ms"] = round(step_s * 1e3, 3)
+    _bump_progress()
+
+    # (c) injected-NaN detection latency at the trainer's sync cadence:
+    # NaN enters the batch at inject_step; the halt sentinel may only look
+    # every sync_every steps (the loss_sync contract), so detection lands
+    # at the next sync point — report both the step gap and the wall gap
+    tmp = tempfile.mkdtemp(prefix="dsml_forensics_bench_")
+    reg_nan = obs.Registry(enabled=True)
+    rec_nan = obs.FlightRecorder(registry=reg_nan, directory=tmp)
+    sent = TrainingSentinels(
+        SentinelConfig(nonfinite="halt"), registry=reg_nan, recorder=rec_nan,
+    )
+    sync_every, inject_step = 8, 20
+    nan_x = jnp.asarray(np.full_like(x_host, np.nan))
+    pp, oo = p0, o0
+    trip_step = bundle = None
+    t_inject = None
+    try:
+        for k in range(1, 65):
+            if k == inject_step:
+                t_inject = time.perf_counter()
+            pp, oo, ls = fused_fn(pp, oo, nan_x if k >= inject_step else xb)
+            rec_nan.record("step", step=k)
+            if k % sync_every == 0:
+                try:
+                    sent.check(k, float(ls))
+                except SentinelTripped as e:
+                    trip_step, bundle = k, e.bundle
+                    out["forensics_nan_detect_ms"] = round(
+                        (time.perf_counter() - t_inject) * 1e3, 3
+                    )
+                    break
+        if trip_step is None:
+            out["forensics_nan_error"] = "sentinel never tripped"
+        else:
+            out["forensics_nan_inject_step"] = inject_step
+            out["forensics_nan_trip_step"] = trip_step
+            out["forensics_nan_detect_steps"] = trip_step - inject_step
+            out["forensics_nan_sync_every"] = sync_every
+            if bundle:
+                with open(os.path.join(bundle, "MANIFEST.json")) as f:
+                    manifest = json.load(f)
+                out["forensics_bundle_events"] = manifest["event_count"]
+                out["forensics_bundle_files"] = manifest["files"]
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    _bump_progress()
+    out["forensics_note"] = (
+        "disabled/enabled rows are the trainer's per-step forensic bundle "
+        "cost vs a fused step (<1% bar each); the NaN row injects at step "
+        f"{inject_step} and detection is bounded by the sync cadence"
+    )
+    return out
+
+
 def _preflight_device() -> bool:
     """True when the default device actually executes work. The axon tunnel
     can die such that every TPU call hangs forever (no error) — probe with a
@@ -2340,6 +2505,7 @@ _SECTIONS = {
     "bucket_sweep": bench_bucket_sweep,  # virtual-8 sweep; no TPU rows
     "checkpoint": bench_checkpoint,
     "obs": bench_obs,
+    "forensics": bench_forensics,
 }
 
 
@@ -2642,6 +2808,15 @@ def main() -> None:
             extras.update(bench_obs())
         except Exception as e:
             errors["obs"] = repr(e)[:300]
+        _bump_progress()
+    # failure-forensics rows (every backend): sentinel/hangwatch per-step
+    # overhead guards (disabled AND enabled must stay <1% of a fused step)
+    # plus the injected-NaN detection-latency measurement
+    if not _skip_for_budget(extras, "forensics", 90):
+        try:
+            extras.update(bench_forensics())
+        except Exception as e:
+            errors["forensics"] = repr(e)[:300]
         _bump_progress()
     # gradient-bucketing sweep (virtual-8 subprocess, every backend): the
     # data the DSML_BUCKET_MB default is chosen from — cheap enough to ride

@@ -16,10 +16,29 @@ very next save, not at shutdown.
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import time
 
 from dsml_tpu.obs import get_registry
+from dsml_tpu.obs import flight_recorder, hangwatch
+from dsml_tpu.utils.logging import get_logger
+
+log = get_logger("ckpt-writer")
+
+# a background commit outliving this many seconds is suspect: wait() warns
+# with queue depth + the in-flight label instead of blocking silently, and
+# with DSML_HANGWATCH set the worker's armed deadline dumps stacks + bundle
+DEFAULT_COMMIT_DEADLINE_S = 120.0
+
+
+def _commit_deadline_s() -> float:
+    try:
+        v = float(os.environ.get("DSML_CKPT_COMMIT_DEADLINE_S",
+                                 DEFAULT_COMMIT_DEADLINE_S))
+    except ValueError:
+        return DEFAULT_COMMIT_DEADLINE_S
+    return v if v > 0 else DEFAULT_COMMIT_DEADLINE_S
 
 
 class AsyncWriter:
@@ -30,18 +49,30 @@ class AsyncWriter:
     ``checkpoint_commit_ms`` histogram (per-job wall), and
     ``checkpoint_errors_total`` counter (background failures held for the
     caller — the sticky-error path is otherwise invisible until the next
-    ``save``)."""
+    ``save``). Each commit lands a ``checkpoint_commit`` flight-recorder
+    event, and a commit (or a ``wait()``) exceeding ``deadline_s`` logs a
+    warning carrying the queue depth and the in-flight job's label — a
+    full NFS mount blocks loudly instead of forever."""
 
-    def __init__(self, name: str = "ckpt-writer"):
+    def __init__(self, name: str = "ckpt-writer",
+                 deadline_s: float | None = None):
         self._name = name
+        self.deadline_s = (deadline_s if deadline_s is not None
+                           else _commit_deadline_s())
         self._jobs: collections.deque = collections.deque()
         self._lock = threading.Lock()
         self._idle = threading.Condition(self._lock)
         self._busy = False
+        self._busy_since: float | None = None
+        self._overdue_warned = False
+        self._current_label: str | None = None
         self._error: BaseException | None = None
         self._thread: threading.Thread | None = None
         self._closed = False
         self._obs = get_registry()
+        self._recorder = flight_recorder.get_flight_recorder()
+        hw_cfg = hangwatch.config_from_env()
+        self._hangwatch = hangwatch.get_hangwatch() if hw_cfg is not None else None
 
     def _note_depth(self) -> None:
         # caller holds self._lock
@@ -50,14 +81,28 @@ class AsyncWriter:
             labels=("writer",),
         ).set(len(self._jobs) + (1 if self._busy else 0), writer=self._name)
 
-    def submit(self, fn) -> None:
+    def submit(self, fn, label: str | None = None) -> None:
         """Queue ``fn()`` for background execution; raises any held error
-        from a previous job first."""
+        from a previous job first. ``label`` (e.g. ``"step 42"``) names the
+        job in deadline warnings and flight-recorder events."""
         self.check_error()
         with self._lock:
             if self._closed:
                 raise RuntimeError("AsyncWriter is closed")
-            self._jobs.append(fn)
+            # a commit wedged PAST its deadline would otherwise be silent
+            # until wait(): the next save is the natural place to shout
+            if (self._busy and self._busy_since is not None
+                    and not self._overdue_warned
+                    and time.monotonic() - self._busy_since > self.deadline_s):
+                self._overdue_warned = True
+                log.warning(
+                    "commit %s still running after %.0fs (deadline %.0fs, "
+                    "%d queued behind it) — storage may be wedged",
+                    self._current_label or "?",
+                    time.monotonic() - self._busy_since, self.deadline_s,
+                    len(self._jobs),
+                )
+            self._jobs.append((fn, label))
             self._note_depth()
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
@@ -73,13 +118,26 @@ class AsyncWriter:
                     self._idle.wait(timeout=1.0)
                 if not self._jobs:
                     return  # closed and drained
-                fn = self._jobs.popleft()
+                fn, label = self._jobs.popleft()
                 self._busy = True
+                self._busy_since = time.monotonic()
+                self._overdue_warned = False
+                self._current_label = label
+                depth = len(self._jobs) + 1
                 self._note_depth()
+            hw_token = (
+                self._hangwatch.arm(
+                    "checkpoint_commit", self.deadline_s,
+                    label=label or "?", queue_depth=depth, writer=self._name,
+                )
+                if self._hangwatch is not None else None
+            )
             t0 = time.perf_counter()
+            ok = True
             try:
                 fn()
             except BaseException as e:  # noqa: BLE001 — held for the caller
+                ok = False
                 self._obs.counter(
                     "checkpoint_errors_total",
                     "background checkpoint commit failures (held sticky)",
@@ -89,14 +147,31 @@ class AsyncWriter:
                     if self._error is None:
                         self._error = e
             finally:
+                if hw_token is not None:
+                    self._hangwatch.disarm(hw_token)
+                wall_ms = (time.perf_counter() - t0) * 1e3
                 self._obs.histogram(
                     "checkpoint_commit_ms", "background commit wall time",
                     labels=("writer",),
-                ).observe((time.perf_counter() - t0) * 1e3, writer=self._name)
+                ).observe(wall_ms, writer=self._name)
+                self._recorder.record(
+                    "checkpoint_commit", writer=self._name,
+                    label=label or "?", ms=round(wall_ms, 3), ok=ok,
+                )
                 with self._lock:
+                    queued_behind = len(self._jobs)
                     self._busy = False
+                    self._busy_since = None
+                    self._current_label = None
                     self._note_depth()
                     self._idle.notify_all()
+                if wall_ms > self.deadline_s * 1e3:
+                    log.warning(
+                        "commit %s took %.1fs (deadline %.0fs, %d queued "
+                        "behind it) — storage is falling behind the save "
+                        "cadence", label or "?", wall_ms / 1e3,
+                        self.deadline_s, queued_behind,
+                    )
 
     def check_error(self) -> None:
         """Re-raise (and clear) the held first error, non-blocking."""
@@ -107,10 +182,26 @@ class AsyncWriter:
 
     def wait(self) -> None:
         """Block until every submitted job has finished; re-raise the first
-        failure."""
+        failure. A wait outliving ``deadline_s`` is never silent: each
+        elapsed deadline logs a warning naming the in-flight job and the
+        queue depth (the commit-deadline sentinel — ISSUE 5), so an
+        operator tailing the log sees WHAT the shutdown is stuck on."""
+        t0 = time.monotonic()
+        warned = 0
         with self._lock:
             while self._jobs or self._busy:
-                self._idle.wait()
+                self._idle.wait(timeout=self.deadline_s)
+                elapsed = time.monotonic() - t0
+                if ((self._jobs or self._busy)
+                        and elapsed >= self.deadline_s * (warned + 1)):
+                    warned += 1
+                    label = self._current_label
+                    depth = len(self._jobs) + (1 if self._busy else 0)
+                    log.warning(
+                        "wait(): still blocked after %.0fs on commit %s "
+                        "(%d job(s) outstanding; deadline %.0fs)",
+                        elapsed, label or "?", depth, self.deadline_s,
+                    )
         self.check_error()
 
     def pending(self) -> int:

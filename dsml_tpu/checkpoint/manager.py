@@ -67,7 +67,9 @@ class CheckpointManager:
             native.commit(directory, snap)
             self._gc()
 
-        self._writer.submit(job)
+        # the label surfaces in commit-deadline warnings and flight events,
+        # so a stuck wait() names the step it is blocked on
+        self._writer.submit(job, label=f"step {step}")
         get_registry().counter(
             "checkpoint_saves_total", "checkpoint save submissions",
             labels=("mode",),

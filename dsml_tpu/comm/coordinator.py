@@ -38,6 +38,7 @@ from dsml_tpu.comm import rpc
 from dsml_tpu.comm.device_server import DeviceError, local_device
 from dsml_tpu.comm.proto import gpu_sim_pb2 as pb
 from dsml_tpu.obs import get_registry, observe_collective_latency_ms
+from dsml_tpu.obs import flight_recorder, hangwatch
 from dsml_tpu.ops.collectives import ReduceOp, make_stacked_all_reduce
 from dsml_tpu.utils.config import Config, field as cfg_field
 from dsml_tpu.utils.logging import get_logger
@@ -61,6 +62,11 @@ class CoordinatorConfig(Config):
         help="on device failure, re-rank the surviving devices and keep the "
         "communicator alive instead of failing it permanently (the reference "
         "marks it FAILED forever, SURVEY.md §5.3)",
+    )
+    straggler_multiplier: float = cfg_field(
+        3.0,
+        help="a device whose health-probe latency exceeds this multiple of "
+        "the pass's median counts into the coordinator_stragglers gauge",
     )
 
 
@@ -106,6 +112,19 @@ class CoordinatorRuntime:
         self._next_comm = 1
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # failure forensics: wire ops ride in the flight-recorder ring, and
+        # with DSML_HANGWATCH set each collective arms a deadline at k× the
+        # trailing-median op wall — a wedged (alive-but-stuck) device then
+        # leaves a stack dump + bundle instead of a silently hung client
+        self._recorder = flight_recorder.get_flight_recorder()
+        hw_cfg = hangwatch.config_from_env()
+        self._hangwatch = hangwatch.get_hangwatch() if hw_cfg is not None else None
+        self._wire_deadline = (
+            hangwatch.TrailingDeadline.from_config(
+                hw_cfg, floor_s=max(2 * self.config.probe_timeout_s, 1.0)
+            )
+            if hw_cfg is not None else None
+        )
         self._health_thread = threading.Thread(target=self._health_loop, daemon=True)
         self._health_thread.start()
 
@@ -270,17 +289,34 @@ class CoordinatorRuntime:
                 comm.queued.append(run)
                 return
             comm.in_flight += 1
+        hw_token = None
+        if self._hangwatch is not None:
+            deadline_s = self._wire_deadline.timeout_s()
+            if deadline_s is not None:
+                hw_token = self._hangwatch.arm(
+                    "wire_op", deadline_s, comm=comm_id, count=count,
+                    algorithm=self.config.ring_algorithm,
+                )
         t0 = time.perf_counter()
         try:
             run()
+            wall_s = time.perf_counter() - t0
             # per-op latency, labeled by the algorithm that actually ran —
             # the accounting surface the reference reported as totalTimeMs
             observe_collective_latency_ms(
-                self.config.ring_algorithm,
-                (time.perf_counter() - t0) * 1e3,
+                self.config.ring_algorithm, wall_s * 1e3,
                 payload_bytes=count, axis="wire",
             )
+            self._recorder.record(
+                "wire_op", comm=comm_id, count=count,
+                algorithm=self.config.ring_algorithm,
+                ms=round(wall_s * 1e3, 3),
+            )
         finally:
+            if self._hangwatch is not None:
+                if hw_token is not None:
+                    self._hangwatch.disarm(hw_token)
+                self._wire_deadline.observe(time.perf_counter() - t0)
             with comm.lock:
                 comm.in_flight -= 1
 
@@ -509,23 +545,60 @@ class CoordinatorRuntime:
 
     def _check_comm_health(self, comm: Communicator) -> None:
         alive, failed = [], []
+        probe_ms: dict[int, float] = {}  # device_id -> probe latency
         for info in comm.devices:
+            t0 = time.perf_counter()
             try:
                 info.stub.GetDeviceMetadata(
                     pb.GetDeviceMetadataRequest(), timeout=self.config.probe_timeout_s
                 )
+                probe_ms[info.device_id] = (time.perf_counter() - t0) * 1e3
                 alive.append(info)
             except grpc.RpcError:
                 failed.append(info)
         # per-probe outcome counts (matching the reference's health loop,
         # now queryable instead of log-only)
-        probes = get_registry().counter(
+        reg = get_registry()
+        probes = reg.counter(
             "coordinator_health_probes_total", "device health-probe outcomes",
             labels=("outcome",),
         )
         probes.inc(len(alive), outcome="alive")
         if failed:
             probes.inc(len(failed), outcome="failed")
+        # per-device probe latency + straggler derivation: the loop used to
+        # discard timing and only count alive/failed — but at pod scale the
+        # run-killers are devices that answer SLOWLY, not just dead ones
+        stragglers = 0
+        if probe_ms:
+            lat_hist = reg.histogram(
+                "coordinator_probe_ms", "per-device health-probe latency",
+                labels=("device",),
+            )
+            for device_id, ms in probe_ms.items():
+                lat_hist.observe(ms, device=device_id)
+            lats = sorted(probe_ms.values())
+            median = lats[len(lats) // 2]
+            bar = self.config.straggler_multiplier * max(median, 1e-6)
+            slow = {d: ms for d, ms in probe_ms.items() if ms > bar}
+            stragglers = len(slow)
+            if slow:
+                log.warning(
+                    "health: comm %d stragglers (> %.1f ms = %.1f× median): %s",
+                    comm.comm_id, bar, self.config.straggler_multiplier,
+                    {d: round(ms, 1) for d, ms in slow.items()},
+                )
+        # set UNCONDITIONALLY: an all-probes-failed pass must zero the gauge,
+        # not leave the previous pass's count standing during the outage
+        reg.gauge(
+            "coordinator_stragglers",
+            "devices whose probe latency exceeds k× the pass median",
+        ).set(stragglers)
+        self._recorder.record(
+            "health_probe", comm=comm.comm_id, alive=len(alive),
+            failed=len(failed), stragglers=stragglers,
+            probe_ms={str(d): round(ms, 3) for d, ms in probe_ms.items()},
+        )
         if failed:
             if self.config.elastic and alive:
                 # Elastic recovery: shrink the ring and keep going — the

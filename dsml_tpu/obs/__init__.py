@@ -18,17 +18,37 @@ goodput across preemptions, MFU):
 - :mod:`~dsml_tpu.obs.export` — rotation-safe JSONL sink
   (:class:`MetricsLogger`) + opt-in HTTP ``/metrics`` endpoint.
 
+Failure forensics (``docs/OBSERVABILITY.md`` § Failure forensics):
+
+- :mod:`~dsml_tpu.obs.flight_recorder` — bounded ring of recent
+  structured events; dumps a self-contained postmortem bundle (events
+  JSONL + registry snapshot + Chrome trace + env fingerprint + log tail
+  + all-thread stacks) on unhandled exception, SIGTERM, or on demand.
+- :mod:`~dsml_tpu.obs.sentinels` — NaN/Inf-loss, grad-norm-explosion and
+  loss-spike sentinels with per-sentinel ``warn``/``dump``/``halt``
+  policies (``DSML_SENTINELS``), checked at the trainer's existing
+  ``loss_sync`` point.
+- :mod:`~dsml_tpu.obs.hangwatch` — armable deadline watchdog
+  (``DSML_HANGWATCH``): trainer per loss-sync window, coordinator per
+  wire op, checkpoint writer per commit; expiry dumps stacks + a bundle.
+
 Metric names, label sets, and the span taxonomy are specified in
 ``docs/OBSERVABILITY.md``.
 """
 
 from __future__ import annotations
 
+from dsml_tpu.obs import flight_recorder, hangwatch, sentinels  # noqa: F401
 from dsml_tpu.obs.export import (  # noqa: F401
     MetricsLogger,
     MetricsServer,
     start_metrics_server,
 )
+from dsml_tpu.obs.flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    get_flight_recorder,
+)
+from dsml_tpu.obs.hangwatch import HangWatch, TrailingDeadline, get_hangwatch  # noqa: F401
 from dsml_tpu.obs.registry import (  # noqa: F401
     DEFAULT_LATENCY_BUCKETS_MS,
     Counter,
@@ -36,10 +56,15 @@ from dsml_tpu.obs.registry import (  # noqa: F401
     Histogram,
     ObsUnavailable,
     Registry,
-    disable,
-    enable,
     enabled,
     get_registry,
+)
+from dsml_tpu.obs.registry import disable as _registry_disable
+from dsml_tpu.obs.registry import enable as _registry_enable
+from dsml_tpu.obs.sentinels import (  # noqa: F401
+    SentinelConfig,
+    SentinelTripped,
+    TrainingSentinels,
 )
 from dsml_tpu.obs.spans import SpanTracer, get_tracer, span  # noqa: F401
 from dsml_tpu.obs.step_stats import (  # noqa: F401
@@ -57,7 +82,42 @@ __all__ = [
     "StepBreakdown", "GoodputTracker", "mfu", "STEP_PHASES",
     "MetricsLogger", "MetricsServer", "start_metrics_server",
     "record_collective_plan", "observe_collective_latency_ms",
+    "FlightRecorder", "get_flight_recorder", "dump_postmortem",
+    "SentinelConfig", "SentinelTripped", "TrainingSentinels",
+    "HangWatch", "TrailingDeadline", "get_hangwatch",
 ]
+
+
+def enable(forensics: bool = True) -> None:
+    """Turn observability on: flip the default registry live and (unless
+    ``forensics=False``) install the failure-forensics layer — the
+    flight-recorder crash hooks (``sys.excepthook`` / SIGTERM /
+    ``faulthandler``) and the ring-buffer log handler whose tail rides in
+    every postmortem bundle. ``disable()`` tears all of it down."""
+    _registry_enable()
+    if forensics:
+        from dsml_tpu.utils.logging import install_ring_handler
+
+        install_ring_handler()
+        flight_recorder.install()
+
+
+def disable() -> None:
+    """Turn observability off and tear down the forensics hooks installed
+    by :func:`enable` (prior excepthook/signal/faulthandler dispositions
+    are restored)."""
+    from dsml_tpu.utils.logging import uninstall_ring_handler
+
+    flight_recorder.uninstall()
+    uninstall_ring_handler()
+    _registry_disable()
+
+
+def dump_postmortem(reason: str = "on_demand",
+                    directory: str | None = None) -> str:
+    """Write a postmortem bundle NOW (works even with the registry
+    disabled); returns the bundle directory."""
+    return get_flight_recorder().dump(reason, directory=directory)
 
 
 def record_collective_plan(algorithm: str, tree, bucket_size_mb,
@@ -113,6 +173,15 @@ def record_collective_plan(algorithm: str, tree, bucket_size_mb,
     )
     for nbytes in sizes:
         hist.observe(nbytes, **labels)
+    # one trace-time event per compile: a postmortem shows WHICH sync plan
+    # (algorithm / bucket count / payload) the dying step was running.
+    # Default-registry callers only — a private registry (bench isolation)
+    # must not leak its plans into the process-global ring
+    if reg is get_registry():
+        flight_recorder.record(
+            "collective_plan", algorithm=algorithm, axis=axis,
+            buckets=n_buckets, bytes=int(sum(sizes)),
+        )
 
 
 def observe_collective_latency_ms(algorithm: str, ms: float,

@@ -25,6 +25,7 @@ import json
 import threading
 import time
 
+from dsml_tpu.obs import flight_recorder
 from dsml_tpu.obs.registry import Registry, get_registry
 
 __all__ = ["SpanTracer", "span", "get_tracer"]
@@ -82,7 +83,14 @@ class SpanTracer:
             with self._lock:
                 self._append({"name": name, "ph": "E", "ts": end_ts,
                               "pid": 0, "tid": tid})
-            self._hist.observe((end_ts - begin["ts"]) / 1e3, name=name)
+            ms = (end_ts - begin["ts"]) / 1e3
+            self._hist.observe(ms, name=name)
+            # span closes ride in the flight-recorder ring, so a postmortem
+            # shows what phases ran right before the failure — but only for
+            # tracers on the DEFAULT registry: a private tracer (bench/test
+            # isolation) must not interleave into the process-global ring
+            if self.registry is get_registry():
+                flight_recorder.record("span", name=name, ms=round(ms, 3))
 
     def _append(self, event: dict) -> None:
         self._events.append(event)

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dsml_tpu.obs import record_collective_plan
+from dsml_tpu.obs import flight_recorder, record_collective_plan
 from dsml_tpu.ops.collectives import ReduceOp
 from dsml_tpu.parallel.bucketing import bucketed_all_reduce, default_bucket_mb
 
@@ -67,6 +67,12 @@ def make_dp_train_step(
     batch_sh = NamedSharding(mesh, P(axis))
     if bucket_size_mb == "auto":
         bucket_size_mb = default_bucket_mb()
+    # build-time breadcrumb: a postmortem names the sync configuration the
+    # dying run was built with, even before the first compile records a plan
+    flight_recorder.record(
+        "train_step_build", algorithm=algorithm, axis=axis,
+        bucket_mb=bucket_size_mb, devices=mesh.devices.size,
+    )
     # Loss-reactive transforms (adaptive_plateau) consume the loss via
     # ``value=``; the wrapper lets every optimizer accept the extra arg.
     optimizer = optax.with_extra_args_support(optimizer)

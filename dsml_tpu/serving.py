@@ -720,9 +720,16 @@ class ContinuousBatcher:
         if self._obs.enabled:
             # admission latency = queue wait + prefill: the serving-side
             # TTFT, as a histogram the /metrics endpoint can expose live
+            admission_ms = (req.first_token_at - req.submitted_at) * 1e3
             self._obs.histogram(
                 "serving_admission_ms", "submit→first-token latency",
-            ).observe((req.first_token_at - req.submitted_at) * 1e3)
+            ).observe(admission_ms)
+            from dsml_tpu.obs import flight_recorder
+
+            flight_recorder.record(
+                "serving_admit", rid=req.rid, prompt_len=len(req.prompt),
+                admission_ms=round(admission_ms, 3),
+            )
         emitted[req.rid] = [tok]
         if self._finished(req, tok):
             self._retire(req)
@@ -844,6 +851,15 @@ class ContinuousBatcher:
             (req.first_token_at or req.finished_at) - req.submitted_at,  # TTFT
             req.finished_at - req.submitted_at,  # e2e
         ))
+        if self._obs.enabled:
+            from dsml_tpu.obs import flight_recorder
+
+            # per-request lifecycle in the flight ring: a serving postmortem
+            # shows which requests were in flight and their tail latencies
+            flight_recorder.record(
+                "serving_retire", rid=req.rid, tokens=len(req.tokens),
+                e2e_ms=round((req.finished_at - req.submitted_at) * 1e3, 3),
+            )
         # move out of the live table so a long-running server doesn't
         # accumulate one Request per lifetime request; collect() drains
         self._done[req.rid] = self._live.pop(req.rid)

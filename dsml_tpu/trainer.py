@@ -18,6 +18,8 @@ import numpy as np
 import optax
 
 from dsml_tpu.obs import GoodputTracker, StepBreakdown, get_registry
+from dsml_tpu.obs import flight_recorder, hangwatch
+from dsml_tpu.obs.sentinels import TrainingSentinels
 from dsml_tpu.parallel.dp import make_dp_train_step, make_eval_step
 from dsml_tpu.parallel.mesh import data_mesh
 from dsml_tpu.utils.config import Config, field
@@ -48,6 +50,7 @@ class TrainConfig(Config):
     keep_checkpoints: int = field(3, help="max checkpoints retained (older steps garbage-collected)")
     resume: bool = field(False, help="resume from the latest checkpoint in checkpoint_dir")
     progress: bool = field(False, help="draw per-epoch train/eval progress bars on stderr (reference client UX)")
+    sync_every: int = field(32, help="device→host loss sync cadence in steps; also the training-health sentinel check point (DSML_SENTINELS — docs/OBSERVABILITY.md)")
 
 
 # The per-epoch bar is ``utils.metrics.ProgressBar`` (the reference
@@ -155,12 +158,45 @@ class Trainer:
         # `bench.py --section obs`) and goodput = productive step time ÷
         # wall across resume/checkpoint events. Disabled: one boolean per
         # step, nothing recorded.
+        # Failure forensics (docs/OBSERVABILITY.md § Failure forensics), all
+        # opt-in and zero-sync by construction:
+        # - sentinels (DSML_SENTINELS) inspect the loss at the EXISTING
+        #   loss_sync point — the scalar is already host-ready there, so the
+        #   fused step gains no device→host round trips;
+        # - hangwatch (DSML_HANGWATCH) arms a deadline per loss-sync window
+        #   at k× the trailing-median window wall, once warmed up;
+        # - the flight recorder gets one "step" event per batch and one
+        #   "loss_sync" per sync.
         obs_reg = get_registry()
+        recorder = flight_recorder.get_flight_recorder()
+        sentinels = TrainingSentinels.maybe_from_env()
+        hw_cfg = hangwatch.config_from_env()
+        hw = hangwatch.get_hangwatch() if hw_cfg is not None else None
+        if sentinels is not None or hw is not None:
+            # forensic env opt-in IMPLIES observability: a halt bundle with
+            # empty event/metric/log sections would defeat the black-box
+            # recorder the operator just asked for. Enable the registry and
+            # install the crash/SIGTERM dump hooks + the log ring
+            # (idempotent; previous hooks are chained, obs.disable restores)
+            from dsml_tpu.utils.logging import install_ring_handler
+
+            obs_reg.enable()
+            install_ring_handler()
+            flight_recorder.install()
         track = obs_reg.enabled
         goodput = GoodputTracker(registry=obs_reg) if track else None
         breakdown = StepBreakdown(registry=obs_reg) if track else None
         if track and start_epoch > 1:
             goodput.mark("restore", epoch=start_epoch - 1)
+        step_deadline = (hangwatch.TrailingDeadline.from_config(hw_cfg)
+                         if hw_cfg is not None else None)
+        sync_every = max(cfg.sync_every, 1)
+        global_step = 0
+        recorder.record(
+            "train_start", epochs=cfg.epochs, batch_size=cfg.batch_size,
+            steps_per_epoch=steps_per_epoch, algorithm=cfg.algorithm,
+            start_epoch=start_epoch,
+        )
 
         history = []
         t0 = time.monotonic()
@@ -168,7 +204,6 @@ class Trainer:
             losses = []  # device arrays; synced only every sync_every steps so
             # dispatch of step k+1 overlaps execution of step k without the
             # in-flight queue growing unboundedly
-            sync_every = 32
             batches = prefetch_batches(
                 shard_batches(data.train_x, data.train_y, cfg.batch_size, seed=cfg.seed + epoch)
             )
@@ -176,24 +211,63 @@ class Trainer:
                               enabled=cfg.progress)
             epoch_t0 = time.monotonic()
             t_prev = time.perf_counter()
-            for x, y in batches:
-                if track:
-                    t_data = time.perf_counter()
-                    breakdown.add("data", t_data - t_prev)
-                params, opt_state, loss = self._step_fn(params, opt_state, x, y)
-                if track:
-                    t_disp = time.perf_counter()
-                    breakdown.add("step_dispatch", t_disp - t_data)
-                losses.append(loss)
-                bar.update()
-                if len(losses) % sync_every == 0:
-                    losses[-1].block_until_ready()
+            # Hangwatch covers the SYNC WINDOW, not single batches: async
+            # dispatch makes 31 of every 32 batch walls sub-ms (only the
+            # sync_every-th blocks in block_until_ready), so a per-batch
+            # median would collapse the deadline to the floor and fire on
+            # every healthy sync. The window wall — sync to sync — is the
+            # unimodal quantity a wedged collective actually stretches.
+            hw_token = None
+            win_t0 = t_prev
+            try:
+                for x, y in batches:
+                    global_step += 1
+                    if hw is not None and hw_token is None:
+                        deadline_s = step_deadline.timeout_s()
+                        if deadline_s is not None:
+                            hw_token = hw.arm("train_sync_window", deadline_s,
+                                              step=global_step, epoch=epoch)
                     if track:
-                        breakdown.add("loss_sync", time.perf_counter() - t_disp)
-                if track:
-                    now = time.perf_counter()
-                    breakdown.note_step_wall(now - t_prev)
-                    t_prev = now
+                        t_data = time.perf_counter()
+                        breakdown.add("data", t_data - t_prev)
+                    params, opt_state, loss = self._step_fn(params, opt_state, x, y)
+                    if track:
+                        t_disp = time.perf_counter()
+                        breakdown.add("step_dispatch", t_disp - t_data)
+                    losses.append(loss)
+                    bar.update()
+                    if len(losses) % sync_every == 0:
+                        losses[-1].block_until_ready()
+                        if track:
+                            breakdown.add("loss_sync", time.perf_counter() - t_disp)
+                        if hw is not None:
+                            if hw_token is not None:
+                                hw.disarm(hw_token)
+                                hw_token = None
+                            now_sync = time.perf_counter()
+                            step_deadline.observe(now_sync - win_t0)
+                            win_t0 = now_sync
+                        if sentinels is not None or track:
+                            # the scalar is already synced; float() is a host read
+                            loss_host = float(losses[-1])
+                            recorder.record("loss_sync", step=global_step,
+                                            epoch=epoch, loss=loss_host)
+                            if sentinels is not None:
+                                # halt-policy trips raise SentinelTripped out of
+                                # train() with the postmortem bundle already on disk
+                                sentinels.check(global_step, loss_host)
+                    if track:
+                        now = time.perf_counter()
+                        breakdown.note_step_wall(now - t_prev)
+                        recorder.record("step", step=global_step, epoch=epoch,
+                                        wall_ms=round((now - t_prev) * 1e3, 3))
+                        t_prev = now
+            finally:
+                # disarm on EVERY exit — a halt/exception (or epoch end with
+                # a partial window) must not leave a deadline that later
+                # fires a spurious hang bundle
+                if hw_token is not None:
+                    hw.disarm(hw_token)
             bar.close()
             if track:
                 # productive = time spent driving steps; eval/logging/
@@ -205,6 +279,8 @@ class Trainer:
             train_acc = self.evaluate(params, data.train_x, data.train_y)
             # Same log shape as the reference's per-epoch line (client.go:650-652).
             log.info("Epoch %d: Average Loss = %.4f, Accuracy = %.2f%%", epoch, em.avg_loss, train_acc * 100)
+            recorder.record("epoch", epoch=epoch, avg_loss=em.avg_loss,
+                            train_accuracy=train_acc)
             history.append(
                 self.metrics.log(epoch=epoch, avg_loss=em.avg_loss, train_accuracy=train_acc)
             )
@@ -228,6 +304,8 @@ class Trainer:
                     breakdown.add("checkpoint_stall",
                                   time.perf_counter() - t_save)
                     goodput.mark("checkpoint_save", epoch=epoch)
+                recorder.record("checkpoint_save", epoch=epoch,
+                                stall_ms=round((time.perf_counter() - t_save) * 1e3, 3))
         last_epoch = cfg.epochs
         if ckpt is not None:
             # final state must always be persisted, even when epochs isn't a

@@ -31,6 +31,8 @@ EVIDENCE = os.path.join(REPO, "BENCH_TPU_evidence.json")
 SECTIONS = [
     ("gpt2", 900),        # ~40 s compile + 10 reps; generous for a slow tunnel
     ("checkpoint", 600),  # save/restore + async-stall row (cheap, one compile)
+    ("forensics", 600),   # sentinel/hangwatch overhead vs a REAL chip step
+    #                       + NaN detection latency (cheap, one compile)
     ("gpt2_decode", 1200),  # plain + wq8 + kv8 + kv4 variants, 2 compiles each
     ("allreduce", 600),   # incl. the e2e wire-path row (VERDICT r3 item 7)
     ("gpt2_seq8k", 900),

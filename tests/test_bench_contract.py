@@ -122,6 +122,34 @@ def test_obs_section_schema():
     assert rows["obs_disabled_overhead_pct"] < 1.0
 
 
+def test_forensics_section_schema():
+    """The BENCH `forensics` section's contract (ISSUE 5 acceptance):
+    sentinel/hangwatch per-step overhead stays under the 1% bar BOTH
+    disabled and enabled, and the injected-NaN row reports a detection
+    latency bounded by the sync cadence plus a complete bundle."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    rows = bench.bench_forensics()
+
+    # (a)+(b) overhead guards — the same <1%-of-a-fused-step bar as obs
+    assert rows["forensics_disabled_overhead_pct"] < 1.0
+    assert rows["forensics_enabled_overhead_pct"] < 1.0
+    assert rows["forensics_disabled_bundle_ns"] > 0
+    assert rows["forensics_enabled_bundle_us"] > 0
+
+    # (c) injected-NaN detection: the sentinel only looks at sync points,
+    # so detection lands within one sync window of the injection
+    assert "forensics_nan_error" not in rows, rows
+    assert rows["forensics_nan_trip_step"] >= rows["forensics_nan_inject_step"]
+    assert rows["forensics_nan_detect_steps"] <= rows["forensics_nan_sync_every"]
+    assert rows["forensics_nan_detect_ms"] > 0
+    # the halt left a complete bundle behind
+    assert rows["forensics_bundle_events"] > 0
+    assert {"events.jsonl", "registry.json", "stacks.txt",
+            "trace.json"} <= set(rows["forensics_bundle_files"])
+
+
 @pytest.mark.slow
 def test_cpu_fallback_emits_under_hung_probe():
     """The capped-preflight path: probe hangs, preflight gives up inside its
