@@ -37,6 +37,12 @@ class CheckpointManager:
         os.makedirs(self.directory, exist_ok=True)
         self.max_to_keep = max_to_keep
         self._writer = AsyncWriter()
+        # reclaim any .trash.* debris a prior delete renamed but could not
+        # remove (busy NFS handles at deletion time)
+        for name in os.listdir(self.directory):
+            if name.startswith(".trash."):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
 
     # -- write ------------------------------------------------------------
 
@@ -85,28 +91,47 @@ class CheckpointManager:
         any background write failure)."""
         self._writer.wait()
 
+    def _delete_step(self, step: int, why: str) -> bool:
+        path = os.path.join(self.directory, native.step_dirname(step))
+        # rename-then-delete: a reader listing steps mid-GC never sees a
+        # half-deleted directory as a valid checkpoint
+        trash = os.path.join(self.directory, f".trash.{native.step_dirname(step)}")
+        try:
+            os.replace(path, trash)
+        except OSError:  # already gone (concurrent GC) — fine
+            return False
+        # the RENAME is the deletion (the step is out of every listing);
+        # a reclaim failure (NFS .nfsXXXX busy files, open fds) must still
+        # count + log the deletion — silence exactly when deletion
+        # misbehaves is how trash dirs quietly eat a disk
+        try:
+            shutil.rmtree(trash)
+        except OSError as e:
+            log.warning("checkpoint step %d removed but %s not yet "
+                        "reclaimed (%s); swept at the next manager open",
+                        step, trash, e)
+        # a silent deletion is how a "lost" checkpoint becomes a
+        # mystery: every deletion names the step AND the path it removed,
+        # through both the logger and the registry
+        get_registry().counter(
+            "checkpoint_gc_total", "checkpoint deletions",
+        ).inc()
+        log.info("deleted checkpoint step %d (%s), reason=%s", step, path, why)
+        return True
+
     def _gc(self) -> None:
         if not self.max_to_keep or self.max_to_keep < 1:
             return
         steps = self.all_steps()
         for step in steps[: -self.max_to_keep]:
-            path = os.path.join(self.directory, native.step_dirname(step))
-            # rename-then-delete: a reader listing steps mid-GC never sees a
-            # half-deleted directory as a valid checkpoint
-            trash = os.path.join(self.directory, f".trash.{native.step_dirname(step)}")
-            try:
-                os.replace(path, trash)
-                shutil.rmtree(trash)
-            except OSError:  # already gone (concurrent GC) — fine
-                continue
-            # a silent deletion is how a "lost" checkpoint becomes a
-            # mystery: every GC names the step AND the path it removed,
-            # through both the logger and the registry
-            get_registry().counter(
-                "checkpoint_gc_total", "max_to_keep checkpoint deletions",
-            ).inc()
-            log.info("garbage-collected checkpoint step %d (%s), max_to_keep=%d",
-                     step, path, self.max_to_keep)
+            self._delete_step(step, f"max_to_keep={self.max_to_keep}")
+
+    def delete_steps(self, steps) -> int:
+        """Explicitly drop committed steps — the elastic controller's
+        lineage-pruning hook (a replay grow-back discards the mixed-width
+        checkpoints so a later fallback cannot mix lineages). Returns how
+        many were actually removed."""
+        return sum(self._delete_step(int(s), "explicit") for s in steps)
 
     # -- read -------------------------------------------------------------
 
@@ -126,9 +151,22 @@ class CheckpointManager:
                 out.append(step)
         return sorted(out)
 
-    def latest_step(self) -> int | None:
+    def latest_step(self, where=None) -> int | None:
+        """Newest committed step; with ``where`` (a predicate over the
+        step's manifest ``meta`` dict), the newest step whose meta
+        satisfies it — how the elastic controller finds the last
+        pure-lineage checkpoint for a replay grow-back."""
         steps = self.all_steps()
-        return steps[-1] if steps else None
+        if where is None:
+            return steps[-1] if steps else None
+        for step in reversed(steps):
+            try:
+                meta = self.meta(step)
+            except (OSError, KeyError, ValueError):
+                continue
+            if where(meta):
+                return step
+        return None
 
     def _step_dir(self, step: int | None) -> str:
         step = step if step is not None else self.latest_step()

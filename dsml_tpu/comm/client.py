@@ -9,6 +9,9 @@ on-device compute path (RunForward/RunBackward) the reference only stubbed.
 
 from __future__ import annotations
 
+import os
+import random
+import time
 from dataclasses import dataclass
 
 import grpc
@@ -19,6 +22,57 @@ from dsml_tpu.comm.proto import gpu_sim_pb2 as pb
 
 GRAD_ADDR = 0x1000  # conventional addresses, as in client.go:29-30
 WEIGHTS_ADDR = 0x2000
+
+# transient control-plane failures worth retrying: the server is restarting
+# / the channel flaked (UNAVAILABLE) or one probe window was missed
+# (DEADLINE_EXCEEDED). Everything else — NOT_FOUND, INVALID_ARGUMENT,
+# FAILED_PRECONDITION — is a REAL answer and retrying it only hides bugs.
+TRANSIENT_CODES = (
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+)
+
+
+def _rpc_code(e: grpc.RpcError):
+    code = getattr(e, "code", None)
+    return code() if callable(code) else None
+
+
+def call_with_retries(op: str, fn, retries: int | None = None,
+                      base_s: float = 0.05, cap_s: float = 2.0,
+                      rng=random.random, sleep=time.sleep):
+    """Run ``fn()`` with bounded exponential backoff + jitter on transient
+    gRPC codes (:data:`TRANSIENT_CODES`); anything else raises immediately.
+
+    The control-plane RPCs this wraps (CommInit / GetCommStatus /
+    membership refresh) are exactly the calls a preemption storm flakes:
+    failing a whole training job on one UNAVAILABLE while the coordinator
+    restarts is the reference's brittleness, not a contract. Retries are
+    BOUNDED (default 4, ``DSML_COMM_RETRIES``) and jittered (0.5–1.5× the
+    exponential delay) so a thundering herd of recovering clients doesn't
+    re-flatten the coordinator it is waiting for. Every retry counts into
+    ``comm_retry_total{op}``."""
+    if retries is None:
+        try:
+            retries = int(os.environ.get("DSML_COMM_RETRIES", 4))
+        except ValueError:
+            retries = 4
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except grpc.RpcError as e:
+            if _rpc_code(e) not in TRANSIENT_CODES or attempt >= retries:
+                raise
+            from dsml_tpu.obs import get_registry
+
+            get_registry().counter(
+                "comm_retry_total",
+                "transient control-plane RPC retries", labels=("op",),
+            ).inc(op=op)
+            delay = min(cap_s, base_s * (2 ** attempt)) * (0.5 + rng())
+            sleep(delay)
+            attempt += 1
 
 
 def f32_to_bytes(x: np.ndarray) -> bytes:
@@ -44,9 +98,14 @@ class PipelineClient:
         cls, coordinator_addr: str, device_addrs: list[str], timeout: float = 5.0
     ) -> "PipelineClient":
         coord = rpc.coordinator_stub(grpc.insecure_channel(coordinator_addr))
-        resp = coord.CommInit(
-            pb.CommInitRequest(numDevices=len(device_addrs), device_addresses=device_addrs),
-            timeout=timeout,
+        resp = call_with_retries(
+            "CommInit",
+            lambda: coord.CommInit(
+                pb.CommInitRequest(
+                    numDevices=len(device_addrs), device_addresses=device_addrs
+                ),
+                timeout=timeout,
+            ),
         )
         devices = [rpc.device_stub(grpc.insecure_channel(a)) for a in device_addrs]
         return cls(
@@ -71,8 +130,6 @@ class PipelineClient:
         (use after a per-rank RPC error), also poll until the membership
         actually DIFFERS from the client's current table — the coordinator's
         health probe may simply not have noticed the failure yet."""
-        import time
-
         # addresses may be unknown (directly-constructed client): fall back
         # to device-id comparison so expect_change still means something
         if self.addresses:
@@ -81,8 +138,16 @@ class PipelineClient:
             current = list(self.device_ids)
         deadline = time.monotonic() + timeout
         while True:
-            resp = self.coordinator.GetCommStatus(
-                pb.GetCommStatusRequest(commId=self.comm_id), timeout=timeout
+            # retries=1 here: the surrounding poll loop IS the retry
+            # mechanism, bounded by `deadline` — the full default budget
+            # would let one wedged-coordinator iteration block ~5× the
+            # caller's timeout before the outer deadline is even checked
+            resp = call_with_retries(
+                "GetCommStatus",
+                lambda: self.coordinator.GetCommStatus(
+                    pb.GetCommStatusRequest(commId=self.comm_id), timeout=timeout
+                ),
+                retries=1,
             )
             ordered = sorted(resp.members, key=lambda m: m.rank)
             if self.addresses:
@@ -217,7 +282,12 @@ class PipelineClient:
     # ---- lifecycle --------------------------------------------------------------
 
     def status(self) -> int:
-        return self.coordinator.GetCommStatus(pb.GetCommStatusRequest(commId=self.comm_id)).status
+        return call_with_retries(
+            "GetCommStatus",
+            lambda: self.coordinator.GetCommStatus(
+                pb.GetCommStatusRequest(commId=self.comm_id)
+            ),
+        ).status
 
     def destroy(self) -> None:
         self.coordinator.CommDestroy(pb.CommDestroyRequest(commId=self.comm_id))

@@ -112,6 +112,10 @@ class CoordinatorRuntime:
         self._next_comm = 1
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # failure listeners: the health loop's verdicts, pushed instead of
+        # polled — the elastic controller subscribes here so a coordinator
+        # death sentence becomes a DeviceLost signal, not a hung step
+        self._failure_listeners: list = []
         # failure forensics: wire ops ride in the flight-recorder ring, and
         # with DSML_HANGWATCH set each collective arms a deadline at k× the
         # trailing-median op wall — a wedged (alive-but-stuck) device then
@@ -130,6 +134,15 @@ class CoordinatorRuntime:
 
     def stop(self) -> None:
         self._stop.set()
+
+    def add_failure_listener(self, fn) -> None:
+        """Subscribe to health-probe death verdicts:
+        ``fn(comm_id, failed_device_ids, alive_device_ids)`` fires from the
+        health loop whenever a probe pass finds dead devices (before any
+        elastic renumbering, so the ids are the pre-failure ones). Listener
+        exceptions are logged, never allowed to wedge the health loop."""
+        with self._lock:
+            self._failure_listeners.append(fn)
 
     # ---- communicator lifecycle -----------------------------------------------
 
@@ -600,6 +613,15 @@ class CoordinatorRuntime:
             probe_ms={str(d): round(ms, 3) for d, ms in probe_ms.items()},
         )
         if failed:
+            with self._lock:
+                listeners = list(self._failure_listeners)
+            for fn in listeners:
+                try:
+                    fn(comm.comm_id,
+                       [i.device_id for i in failed],
+                       [i.device_id for i in alive])
+                except Exception as e:  # noqa: BLE001 — never wedge health
+                    log.warning("failure listener raised: %r", e)
             if self.config.elastic and alive:
                 # Elastic recovery: shrink the ring and keep going — the
                 # Varuna/Bamboo/Oobleck capability the reference shelved as

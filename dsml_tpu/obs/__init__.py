@@ -82,6 +82,7 @@ __all__ = [
     "StepBreakdown", "GoodputTracker", "mfu", "STEP_PHASES",
     "MetricsLogger", "MetricsServer", "start_metrics_server",
     "record_collective_plan", "observe_collective_latency_ms",
+    "observe_recovery_ms",
     "FlightRecorder", "get_flight_recorder", "dump_postmortem",
     "SentinelConfig", "SentinelTripped", "TrainingSentinels",
     "HangWatch", "TrailingDeadline", "get_hangwatch",
@@ -182,6 +183,22 @@ def record_collective_plan(algorithm: str, tree, bucket_size_mb,
             "collective_plan", algorithm=algorithm, axis=axis,
             buckets=n_buckets, bytes=int(sum(sizes)),
         )
+
+
+def observe_recovery_ms(stage: str, ms: float,
+                        registry: Registry | None = None) -> None:
+    """One elastic-recovery latency sample →
+    ``controller_recovery_ms{stage}`` (stages: ``reconfigure`` /
+    ``checkpoint_fallback`` / ``grow_keep`` / ``grow_replay``) — the
+    distribution behind the chaos bench's recovery p50/p99
+    (``bench.py --section chaos``, docs/ELASTIC.md)."""
+    reg = registry if registry is not None else get_registry()
+    if not reg.enabled:
+        return
+    reg.histogram(
+        "controller_recovery_ms",
+        "elastic-controller recovery latency", labels=("stage",),
+    ).observe(ms, stage=stage)
 
 
 def observe_collective_latency_ms(algorithm: str, ms: float,

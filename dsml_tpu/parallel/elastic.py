@@ -46,6 +46,7 @@ __all__ = [
     "ElasticPolicy",
     "check_recoverable",
     "reconfigure",
+    "reshard_onto",
     "restore_from_checkpoint",
     "ElasticState",
 ]
@@ -77,6 +78,10 @@ class ElasticState:
     mesh: Mesh
     spec: MeshSpec
     reasons: tuple[str, ...]  # the auto-planner's audit trail for the new mesh
+    # checkpoint-sourced states carry the step they restored (None for live
+    # reconfigurations) — the supervision loop rewinds its step counter to
+    # exactly this and replays, which is how "lost work" becomes a number
+    step: int | None = None
 
 
 def _leaf_shardings(tree):
@@ -234,7 +239,7 @@ def reconfigure(
             )
 
     cfg = getattr(model, "config", None)
-    old_pp = isinstance(params.get("layers"), dict) if isinstance(params, dict) else False
+    old_pp = _detect_stacked_pp(params)
     plan, survivors = _plan_for_survivors(
         model, model.n_params(params), list(surviving_devices),
         batch_per_device, global_batch, planner_overrides,
@@ -247,6 +252,33 @@ def reconfigure(
     # fetched whole; device_put lays the state out fresh on the new mesh
     pspecs = model.param_specs(pp=plan.spec.pp > 1, fsdp=plan.spec.fsdp)
 
+    host_params, host_opt = _pull_host_state(params, opt_state, lost_devices)
+    if old_pp:
+        # the failed mesh ran a pipeline (stacked layer axis, possibly in
+        # interleave-permuted order for the OLD stage count) — always return
+        # to the canonical per-layer list form first; if the new plan keeps
+        # a pipeline it restacks for the NEW stage count below. Skipping
+        # this when old and new pp happen to match would still be wrong
+        # whenever v>1 and the stage count changed. Unstack params, and
+        # apply the SAME transform to every params-shaped subtree of the
+        # optimizer state (adam's mu/nu mirror the param tree)
+        host_params, host_opt = _unstack_state(host_params, host_opt, cfg, old_pp)
+    host_params, host_opt = _restack_state(host_params, host_opt, cfg, plan.spec.pp)
+    new_params, new_opt = _place_state(
+        host_params, host_opt, optimizer, pspecs, new_mesh
+    )
+    return ElasticState(
+        params=new_params, opt_state=new_opt, mesh=new_mesh, spec=plan.spec,
+        reasons=plan.reasons + torn_note,
+    )
+
+
+def _pull_host_state(params, opt_state, lost_devices):
+    """One host round-trip for the whole training state, never touching a
+    dead device: leaves whose shards all live on survivors fetch plainly;
+    leaves with dead holders reassemble piecewise from surviving addressable
+    shards (pieces whose holders ALL died stay zero — the audited torn-state
+    substitution). Shared by :func:`reconfigure` and :func:`reshard_onto`."""
     lost_ids = {d.id for d in lost_devices}
 
     def pull(leaf):
@@ -262,10 +294,10 @@ def reconfigure(
         # some holder died (torn or not): NEVER device_get the whole leaf —
         # that would materialize dead shards and hang on a real loss.
         # Reassemble piecewise from surviving addressable shards; pieces
-        # whose holders all died stay zero (audited above); a piece that
-        # survives only on a NON-addressable device (another host) can't be
-        # fetched from here — refuse loudly rather than zero silently-good
-        # data the audit said was safe
+        # whose holders all died stay zero (audited by the caller); a piece
+        # that survives only on a NON-addressable device (another host)
+        # can't be fetched from here — refuse loudly rather than zero
+        # silently-good data the audit said was safe
         out = np.zeros(leaf.shape, jnp.dtype(leaf.dtype))
         filled: set = set()
         for shard in leaf.addressable_shards:
@@ -282,32 +314,49 @@ def reconfigure(
             )
         return out
 
-    host_params = jax.tree.map(pull, params)
-    host_opt = jax.tree.map(pull, opt_state)
+    return jax.tree.map(pull, params), jax.tree.map(pull, opt_state)
+
+
+def _detect_stacked_pp(params) -> int:
+    """pp width of a STACKED param tree (0 = list/canonical form): the
+    layer axis is a dict node and some leaf sharding carries a 'pp' mesh
+    axis (width 1 when stacked but pp-less — degenerate, treated as 1)."""
+    if not (isinstance(params, dict) and isinstance(params.get("layers"), dict)):
+        return 0
+    for leaf, sharding in _leaf_shardings(params):
+        if isinstance(sharding, NamedSharding) and "pp" in sharding.mesh.shape:
+            return sharding.mesh.shape["pp"]
+    return 1
+
+
+def reshard_onto(
+    model,
+    optimizer,
+    params,
+    opt_state,
+    mesh: Mesh,
+    spec: MeshSpec,
+    lost_devices=(),
+) -> ElasticState:
+    """Move LIVE state onto a KNOWN mesh — the grow-back primitive.
+
+    :func:`reconfigure` re-plans; this does not: the supervision loop
+    (``runtime.controller``) already knows the topology it is returning to
+    (the pre-failure full mesh), and rebuilding exactly that mesh object
+    keeps the original step function's jit cache valid and the post-grow
+    trajectory bit-comparable to the pre-failure one. Same host round-trip
+    / unstack / restack / place pipeline as :func:`reconfigure`."""
+    cfg = getattr(model, "config", None)
+    host_params, host_opt = _pull_host_state(params, opt_state, lost_devices)
+    old_pp = _detect_stacked_pp(params)
     if old_pp:
-        # the failed mesh ran a pipeline (stacked layer axis, possibly in
-        # interleave-permuted order for the OLD stage count) — always return
-        # to the canonical per-layer list form first; if the new plan keeps
-        # a pipeline it restacks for the NEW stage count below. Skipping
-        # this when old and new pp happen to match would still be wrong
-        # whenever v>1 and the stage count changed. Unstack params, and
-        # apply the SAME transform to every params-shaped subtree of the
-        # optimizer state (adam's mu/nu mirror the param tree)
-        old_pp_size = None
-        for leaf, sharding in _leaf_shardings(params):
-            if isinstance(sharding, NamedSharding) and "pp" in sharding.mesh.shape:
-                old_pp_size = sharding.mesh.shape["pp"]
-                break
-        host_params, host_opt = _unstack_state(
-            host_params, host_opt, cfg, old_pp_size or 1
-        )
-    host_params, host_opt = _restack_state(host_params, host_opt, cfg, plan.spec.pp)
-    new_params, new_opt = _place_state(
-        host_params, host_opt, optimizer, pspecs, new_mesh
-    )
+        host_params, host_opt = _unstack_state(host_params, host_opt, cfg, old_pp)
+    host_params, host_opt = _restack_state(host_params, host_opt, cfg, spec.pp)
+    pspecs = model.param_specs(pp=spec.pp > 1, fsdp=spec.fsdp)
+    new_params, new_opt = _place_state(host_params, host_opt, optimizer, pspecs, mesh)
     return ElasticState(
-        params=new_params, opt_state=new_opt, mesh=new_mesh, spec=plan.spec,
-        reasons=plan.reasons + torn_note,
+        params=new_params, opt_state=new_opt, mesh=mesh, spec=spec,
+        reasons=(f"resharded live state onto the given mesh {spec.sizes_dict()}",),
     )
 
 
@@ -492,4 +541,5 @@ def restore_from_checkpoint(
         reasons=plan.reasons
         + (f"restored from checkpoint step {manifest['step']} "
            f"(saved pp={saved_pp}, {'stacked' if saved_stacked else 'list'} form)",),
+        step=int(manifest["step"]),
     )

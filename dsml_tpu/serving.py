@@ -40,7 +40,15 @@ import numpy as np
 
 from dsml_tpu.obs import get_registry
 
-__all__ = ["Request", "ContinuousBatcher"]
+__all__ = ["Request", "ContinuousBatcher", "QueueFull"]
+
+
+class QueueFull(RuntimeError):
+    """``submit`` rejected by the queue cap (``max_queue``): the batcher
+    sheds load explicitly instead of letting an unbounded queue grow until
+    every request's latency is unbounded too. Counted in
+    ``serving_shed_total``; callers (routers, the ``DecodeFleet``) retry
+    elsewhere or surface backpressure upstream."""
 
 
 @dataclasses.dataclass
@@ -196,6 +204,7 @@ class ContinuousBatcher:
         speculative_window: int = 0,
         speculative_ngram: int = 2,
         adaptive_quantum: int = 0,
+        max_queue: int = 0,
         mesh=None,
     ):
         """``mesh`` — a framework mesh (``parallel.mesh.build_mesh``) makes
@@ -230,6 +239,15 @@ class ContinuousBatcher:
         # decode mask (>= 0) nor the free-slot scan (== -1) touches it
         self._pending = None
 
+        if max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0 (0 = unbounded), got {max_queue}")
+        # queue cap: an unbounded admission queue under overload grows
+        # without limit — memory, and every queued request's latency, with
+        # it. A cap makes overload an EXPLICIT signal (QueueFull +
+        # serving_shed_total) the caller can act on (shed, retry elsewhere,
+        # backpressure) instead of a slow collapse. 0 keeps the historical
+        # unbounded behavior.
+        self.max_queue = int(max_queue)
         self._obs = get_registry()  # no-op unless observability is enabled
         self._queue: deque[Request] = deque()
         self._live: dict[int, Request] = {}  # queued or in a slot
@@ -602,6 +620,17 @@ class ContinuousBatcher:
         if not self._chunk_grid_fits(len(prompt)):
             # whole-prompt bucketed admission → reject at submit, not admit
             _bucket(len(prompt), self.prompt_buckets)
+        if self.max_queue and len(self._queue) >= self.max_queue:
+            # shed AFTER validation: a malformed request is the caller's
+            # bug (ValueError), a full queue is the deployment's state
+            self._obs.counter(
+                "serving_shed_total",
+                "requests rejected at submit by the queue cap",
+            ).inc()
+            raise QueueFull(
+                f"admission queue at its cap ({self.max_queue} waiting); "
+                "request shed — retry on another replica or back off"
+            )
         rid = self._next_rid
         self._next_rid += 1
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
@@ -1088,6 +1117,29 @@ class ContinuousBatcher:
                     f"{self.model.config.max_seq}"
                 )
         return emitted
+
+    def abandon(self) -> list[Request]:
+        """Evacuate every UNFINISHED request — queued, mid-chunked-
+        admission, and mid-decode — and reset the scheduler state (the
+        replica-failure path: a ``DecodeFleet`` resubmits the returned
+        requests' prompts on surviving replicas; with greedy decoding the
+        re-run emits identical tokens, so a replica loss costs latency,
+        never tokens). Already-retired results stay collectable via
+        :meth:`collect`. Cache contents become garbage that the next
+        admissions fully overwrite (the same invariant a fresh batcher
+        starts with)."""
+        live = [self._live[rid] for rid in sorted(self._live)]
+        self._queue.clear()
+        self._live.clear()
+        self._pending = None
+        self._slot_rid[:] = -1
+        self._pos[:] = 0
+        self._last_tok[:] = 0
+        if self._obs.enabled:
+            from dsml_tpu.obs import flight_recorder
+
+            flight_recorder.record("serving_abandon", n_requests=len(live))
+        return live
 
     def collect(self) -> dict[int, list]:
         """{rid: [tokens]} for every request retired since the last collect

@@ -47,6 +47,7 @@ class TrainConfig(Config):
     log_metrics: str = field("", help="optional JSONL metrics path")
     checkpoint_dir: str = field("", help="checkpoint directory ('' = no checkpointing; native sharded backend, docs/CHECKPOINT.md)")
     save_every: int = field(1, help="checkpoint every N epochs")
+    save_every_steps: int = field(0, help="ALSO checkpoint every N steps mid-epoch (0 = epoch boundaries only); the data-loader position (epoch, consumed batches) rides the manifest so a preempted run resumes mid-epoch bit-identically; step-granularity saves use the global step as the checkpoint id")
     keep_checkpoints: int = field(3, help="max checkpoints retained (older steps garbage-collected)")
     resume: bool = field(False, help="resume from the latest checkpoint in checkpoint_dir")
     progress: bool = field(False, help="draw per-epoch train/eval progress bars on stderr (reference client UX)")
@@ -125,6 +126,7 @@ class Trainer:
 
         ckpt = None
         start_epoch = 1
+        resume_skip = 0  # batches already consumed of start_epoch (mid-epoch resume)
         if cfg.checkpoint_dir:
             from dsml_tpu.checkpoint import CheckpointManager
 
@@ -148,8 +150,20 @@ class Trainer:
                 state = ckpt.restore(template={"params": params, "opt_state": opt_state,
                                                "meta": {"epoch": 0}})
                 params, opt_state = state["params"], state["opt_state"]
-                start_epoch = int(state["meta"]["epoch"]) + 1
-                log.info("resumed from checkpoint at epoch %d", start_epoch - 1)
+                it_state = ckpt.iterator_state() or {}
+                if int(it_state.get("consumed", 0)) > 0:
+                    # mid-epoch checkpoint (save_every_steps): restart
+                    # INSIDE the epoch — shard_batches re-derives the same
+                    # shuffle from (seed + epoch), and fast-forwarding past
+                    # the consumed prefix makes the remaining batches
+                    # bit-identical to the uninterrupted run's
+                    start_epoch = int(it_state["epoch"])
+                    resume_skip = int(it_state["consumed"])
+                    log.info("resumed mid-epoch %d at batch %d",
+                             start_epoch, resume_skip)
+                else:
+                    start_epoch = int(state["meta"]["epoch"]) + 1
+                    log.info("resumed from checkpoint at epoch %d", start_epoch - 1)
 
         # Observability (docs/OBSERVABILITY.md): when the registry is
         # enabled, the loop records a per-step breakdown (data /
@@ -191,132 +205,177 @@ class Trainer:
         step_deadline = (hangwatch.TrailingDeadline.from_config(hw_cfg)
                          if hw_cfg is not None else None)
         sync_every = max(cfg.sync_every, 1)
-        global_step = 0
+        save_every_steps = max(cfg.save_every_steps, 0)
+        global_step = (start_epoch - 1) * steps_per_epoch + resume_skip
         recorder.record(
             "train_start", epochs=cfg.epochs, batch_size=cfg.batch_size,
             steps_per_epoch=steps_per_epoch, algorithm=cfg.algorithm,
             start_epoch=start_epoch,
         )
 
+        def save_ckpt(epochs_done: int, it_epoch: int, consumed_now: int,
+                      wait: bool = False) -> None:
+            """THE checkpoint write, shared by all three call sites
+            (mid-epoch, epoch boundary, final) so the id scheme and
+            manifest layout cannot drift apart: id = GLOBAL STEP when
+            step-granularity saves are on (one monotonic id space), the
+            completed-epoch number otherwise; the loader position
+            (it_epoch, consumed_now) rides the manifest. With wait=False
+            the step loop pays only the synchronous host snapshot +
+            enqueue (the commit rides the writer thread and surfaces as
+            checkpoint_commit_ms)."""
+            t_save = time.perf_counter()
+            ckpt.save(global_step if save_every_steps else epochs_done,
+                      {"params": params, "opt_state": opt_state,
+                       "meta": {"epoch": epochs_done}},
+                      iterator_state={"epoch": it_epoch,
+                                      "consumed": consumed_now},
+                      wait=wait)
+            if track:
+                breakdown.add("checkpoint_stall", time.perf_counter() - t_save)
+                goodput.mark("checkpoint_save", epoch=it_epoch,
+                             step=global_step)
+            recorder.record(
+                "checkpoint_save", epoch=it_epoch, step=global_step,
+                stall_ms=round((time.perf_counter() - t_save) * 1e3, 3))
+
         history = []
         t0 = time.monotonic()
-        for epoch in range(start_epoch, cfg.epochs + 1):
-            losses = []  # device arrays; synced only every sync_every steps so
-            # dispatch of step k+1 overlaps execution of step k without the
-            # in-flight queue growing unboundedly
-            batches = prefetch_batches(
-                shard_batches(data.train_x, data.train_y, cfg.batch_size, seed=cfg.seed + epoch)
-            )
-            bar = ProgressBar(steps_per_epoch, desc=f"Epoch {epoch}/{cfg.epochs}",
-                              enabled=cfg.progress)
-            epoch_t0 = time.monotonic()
-            t_prev = time.perf_counter()
-            # Hangwatch covers the SYNC WINDOW, not single batches: async
-            # dispatch makes 31 of every 32 batch walls sub-ms (only the
-            # sync_every-th blocks in block_until_ready), so a per-batch
-            # median would collapse the deadline to the floor and fire on
-            # every healthy sync. The window wall — sync to sync — is the
-            # unimodal quantity a wedged collective actually stretches.
-            hw_token = None
-            win_t0 = t_prev
-            try:
-                for x, y in batches:
-                    global_step += 1
-                    if hw is not None and hw_token is None:
-                        deadline_s = step_deadline.timeout_s()
-                        if deadline_s is not None:
-                            hw_token = hw.arm("train_sync_window", deadline_s,
-                                              step=global_step, epoch=epoch)
-                    if track:
-                        t_data = time.perf_counter()
-                        breakdown.add("data", t_data - t_prev)
-                    params, opt_state, loss = self._step_fn(params, opt_state, x, y)
-                    if track:
-                        t_disp = time.perf_counter()
-                        breakdown.add("step_dispatch", t_disp - t_data)
-                    losses.append(loss)
-                    bar.update()
-                    if len(losses) % sync_every == 0:
-                        losses[-1].block_until_ready()
+        train_body_done = False
+        try:
+            for epoch in range(start_epoch, cfg.epochs + 1):
+                losses = []  # device arrays; synced only every sync_every steps so
+                # dispatch of step k+1 overlaps execution of step k without the
+                # in-flight queue growing unboundedly
+                batches = prefetch_batches(
+                    shard_batches(data.train_x, data.train_y, cfg.batch_size, seed=cfg.seed + epoch)
+                )
+                skip = resume_skip if epoch == start_epoch else 0
+                if skip:
+                    import itertools
+
+                    # fast-forward the deterministic stream past the consumed
+                    # prefix — the prefetcher never over-advances the recorded
+                    # position (ResumableIterator's contract, inlined)
+                    batches = itertools.islice(batches, skip, None)
+                consumed = skip
+                bar = ProgressBar(steps_per_epoch - skip,
+                                  desc=f"Epoch {epoch}/{cfg.epochs}",
+                                  enabled=cfg.progress)
+                epoch_t0 = time.monotonic()
+                t_prev = time.perf_counter()
+                # Hangwatch covers the SYNC WINDOW, not single batches: async
+                # dispatch makes 31 of every 32 batch walls sub-ms (only the
+                # sync_every-th blocks in block_until_ready), so a per-batch
+                # median would collapse the deadline to the floor and fire on
+                # every healthy sync. The window wall — sync to sync — is the
+                # unimodal quantity a wedged collective actually stretches.
+                hw_token = None
+                win_t0 = t_prev
+                try:
+                    for x, y in batches:
+                        global_step += 1
+                        consumed += 1
+                        if hw is not None and hw_token is None:
+                            deadline_s = step_deadline.timeout_s()
+                            if deadline_s is not None:
+                                hw_token = hw.arm("train_sync_window", deadline_s,
+                                                  step=global_step, epoch=epoch)
                         if track:
-                            breakdown.add("loss_sync", time.perf_counter() - t_disp)
-                        if hw is not None:
-                            if hw_token is not None:
-                                hw.disarm(hw_token)
-                                hw_token = None
-                            now_sync = time.perf_counter()
-                            step_deadline.observe(now_sync - win_t0)
-                            win_t0 = now_sync
-                        if sentinels is not None or track:
-                            # the scalar is already synced; float() is a host read
-                            loss_host = float(losses[-1])
-                            recorder.record("loss_sync", step=global_step,
-                                            epoch=epoch, loss=loss_host)
-                            if sentinels is not None:
-                                # halt-policy trips raise SentinelTripped out of
-                                # train() with the postmortem bundle already on disk
-                                sentinels.check(global_step, loss_host)
-                    if track:
-                        now = time.perf_counter()
-                        breakdown.note_step_wall(now - t_prev)
-                        recorder.record("step", step=global_step, epoch=epoch,
-                                        wall_ms=round((now - t_prev) * 1e3, 3))
-                        t_prev = now
-            finally:
-                # disarm on EVERY exit — a halt/exception (or epoch end with
-                # a partial window) must not leave a deadline that later
-                # fires a spurious hang bundle
-                if hw_token is not None:
-                    hw.disarm(hw_token)
-            bar.close()
-            if track:
-                # productive = time spent driving steps; eval/logging/
-                # checkpoint overhead shows up as the goodput gap
-                goodput.add_productive(time.monotonic() - epoch_t0)
-            em = EpochMetrics()
-            for loss in losses:
-                em.update(float(loss), 0, cfg.batch_size)
-            train_acc = self.evaluate(params, data.train_x, data.train_y)
-            # Same log shape as the reference's per-epoch line (client.go:650-652).
-            log.info("Epoch %d: Average Loss = %.4f, Accuracy = %.2f%%", epoch, em.avg_loss, train_acc * 100)
-            recorder.record("epoch", epoch=epoch, avg_loss=em.avg_loss,
-                            train_accuracy=train_acc)
-            history.append(
-                self.metrics.log(epoch=epoch, avg_loss=em.avg_loss, train_accuracy=train_acc)
-            )
-            if ckpt is not None and epoch % max(cfg.save_every, 1) == 0:
-                # async: the write overlaps the next epoch's compute; the
-                # manager's writer barrier (or close()) commits it. Saves
-                # land at epoch boundaries, so the loader position is just
-                # the NEXT epoch's seed — shard_batches re-derives the
-                # shuffle from (cfg.seed + epoch), making resume
-                # bit-identical to the uninterrupted run
-                t_save = time.perf_counter()
-                ckpt.save(epoch,
-                          {"params": params, "opt_state": opt_state,
-                           "meta": {"epoch": epoch}},
-                          iterator_state={"epoch": epoch, "consumed": 0},
-                          wait=False)
+                            t_data = time.perf_counter()
+                            breakdown.add("data", t_data - t_prev)
+                        params, opt_state, loss = self._step_fn(params, opt_state, x, y)
+                        if track:
+                            t_disp = time.perf_counter()
+                            breakdown.add("step_dispatch", t_disp - t_data)
+                        losses.append(loss)
+                        bar.update()
+                        if len(losses) % sync_every == 0:
+                            losses[-1].block_until_ready()
+                            if track:
+                                breakdown.add("loss_sync", time.perf_counter() - t_disp)
+                            if hw is not None:
+                                if hw_token is not None:
+                                    hw.disarm(hw_token)
+                                    hw_token = None
+                                now_sync = time.perf_counter()
+                                step_deadline.observe(now_sync - win_t0)
+                                win_t0 = now_sync
+                            if sentinels is not None or track:
+                                # the scalar is already synced; float() is a host read
+                                loss_host = float(losses[-1])
+                                recorder.record("loss_sync", step=global_step,
+                                                epoch=epoch, loss=loss_host)
+                                if sentinels is not None:
+                                    # halt-policy trips raise SentinelTripped out of
+                                    # train() with the postmortem bundle already on disk
+                                    sentinels.check(global_step, loss_host)
+                        if track:
+                            now = time.perf_counter()
+                            breakdown.note_step_wall(now - t_prev)
+                            recorder.record("step", step=global_step, epoch=epoch,
+                                            wall_ms=round((now - t_prev) * 1e3, 3))
+                            t_prev = now
+                        if (ckpt is not None and save_every_steps
+                                and consumed < steps_per_epoch
+                                and global_step % save_every_steps == 0):
+                            # mid-epoch preemption point: resume
+                            # fast-forwards past the consumed prefix
+                            # bit-identically
+                            save_ckpt(epoch - 1, epoch, consumed)
+                            if track:
+                                t_prev = time.perf_counter()  # save ≠ data time
+                finally:
+                    # disarm on EVERY exit — a halt/exception (or epoch end with
+                    # a partial window) must not leave a deadline that later
+                    # fires a spurious hang bundle
+                    if hw_token is not None:
+                        hw.disarm(hw_token)
+                bar.close()
                 if track:
-                    # what the step loop actually paid: the synchronous
-                    # host snapshot + enqueue (the commit rides the writer
-                    # thread and surfaces as checkpoint_commit_ms)
-                    breakdown.add("checkpoint_stall",
-                                  time.perf_counter() - t_save)
-                    goodput.mark("checkpoint_save", epoch=epoch)
-                recorder.record("checkpoint_save", epoch=epoch,
-                                stall_ms=round((time.perf_counter() - t_save) * 1e3, 3))
-        last_epoch = cfg.epochs
-        if ckpt is not None:
-            # final state must always be persisted, even when epochs isn't a
-            # multiple of save_every (otherwise the reported model is lost and
-            # resume would redo the last epochs)
-            if last_epoch >= start_epoch and last_epoch % max(cfg.save_every, 1) != 0:
-                ckpt.save(last_epoch,
-                          {"params": params, "opt_state": opt_state,
-                           "meta": {"epoch": last_epoch}},
-                          iterator_state={"epoch": last_epoch, "consumed": 0})
-            ckpt.close()
+                    # productive = time spent driving steps; eval/logging/
+                    # checkpoint overhead shows up as the goodput gap
+                    goodput.add_productive(time.monotonic() - epoch_t0)
+                em = EpochMetrics()
+                for loss in losses:
+                    em.update(float(loss), 0, cfg.batch_size)
+                train_acc = self.evaluate(params, data.train_x, data.train_y)
+                # Same log shape as the reference's per-epoch line (client.go:650-652).
+                log.info("Epoch %d: Average Loss = %.4f, Accuracy = %.2f%%", epoch, em.avg_loss, train_acc * 100)
+                recorder.record("epoch", epoch=epoch, avg_loss=em.avg_loss,
+                                train_accuracy=train_acc)
+                history.append(
+                    self.metrics.log(epoch=epoch, avg_loss=em.avg_loss, train_accuracy=train_acc)
+                )
+                if ckpt is not None and epoch % max(cfg.save_every, 1) == 0:
+                    # async: the write overlaps the next epoch's compute; the
+                    # manager's writer barrier (or close()) commits it. Saves
+                    # land at epoch boundaries, so the loader position is just
+                    # the NEXT epoch's seed — shard_batches re-derives the
+                    # shuffle from (cfg.seed + epoch), making resume
+                    # bit-identical to the uninterrupted run
+                    save_ckpt(epoch, epoch, 0)
+            last_epoch = cfg.epochs
+            if ckpt is not None:
+                # final state must always be persisted, even when epochs isn't a
+                # multiple of save_every (otherwise the reported model is lost and
+                # resume would redo the last epochs)
+                if last_epoch >= start_epoch and last_epoch % max(cfg.save_every, 1) != 0:
+                    save_ckpt(last_epoch, last_epoch, 0, wait=True)
+            train_body_done = True
+        finally:
+            if ckpt is not None:
+                # ALWAYS flush: a dying run (preemption signal unwinding,
+                # sentinel halt) still commits its queued async saves — that
+                # checkpoint is exactly what recovery resumes from. A writer
+                # error must not mask the original exception.
+                try:
+                    ckpt.close()
+                except Exception:
+                    if train_body_done:
+                        raise
+                    log.warning("checkpoint close failed during exception "
+                                "unwind", exc_info=True)
         test_acc = self.evaluate(
             params, data.test_x, data.test_y,
             progress_label="Testing" if cfg.progress else None,

@@ -1,0 +1,169 @@
+"""Control-plane resilience: bounded retry with jitter + failure listeners.
+
+A preemption storm flakes exactly the RPCs a recovering client needs
+(CommInit / GetCommStatus); the reference failed the whole job on the
+first UNAVAILABLE. ``comm.client.call_with_retries`` bounds the retries,
+jitters the backoff, counts them into ``comm_retry_total{op}``, and never
+retries REAL answers (NOT_FOUND and friends). The coordinator's
+``add_failure_listener`` turns health-loop death verdicts into push
+signals the elastic controller can consume.
+"""
+
+import grpc
+import numpy as np
+import pytest
+
+from dsml_tpu import obs
+from dsml_tpu.comm.client import PipelineClient, call_with_retries
+from dsml_tpu.comm.proto import gpu_sim_pb2 as pb
+
+
+class _Err(grpc.RpcError):
+    def __init__(self, code):
+        self._code = code
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return "synthetic"
+
+
+def _flaky(n_failures, code=grpc.StatusCode.UNAVAILABLE, result="ok"):
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] <= n_failures:
+            raise _Err(code)
+        return result
+
+    return fn, calls
+
+
+def test_transient_codes_retry_until_success():
+    sleeps = []
+    fn, calls = _flaky(3)
+    out = call_with_retries("op", fn, retries=4, rng=lambda: 0.5,
+                            sleep=sleeps.append)
+    assert out == "ok" and calls["n"] == 4
+    # bounded exponential backoff: base 0.05 doubling, jitter factor 1.0
+    np.testing.assert_allclose(sleeps, [0.05, 0.1, 0.2])
+
+
+def test_deadline_exceeded_is_transient_too():
+    fn, calls = _flaky(1, code=grpc.StatusCode.DEADLINE_EXCEEDED)
+    assert call_with_retries("op", fn, retries=2, sleep=lambda s: None) == "ok"
+    assert calls["n"] == 2
+
+
+def test_non_transient_codes_raise_immediately():
+    fn, calls = _flaky(5, code=grpc.StatusCode.NOT_FOUND)
+    with pytest.raises(grpc.RpcError):
+        call_with_retries("op", fn, retries=4, sleep=lambda s: None)
+    assert calls["n"] == 1  # a real answer is not retried
+
+
+def test_retry_budget_is_bounded():
+    fn, calls = _flaky(100)
+    with pytest.raises(grpc.RpcError):
+        call_with_retries("op", fn, retries=3, sleep=lambda s: None)
+    assert calls["n"] == 4  # 1 attempt + 3 retries, then surrender
+
+
+def test_jitter_spreads_the_herd():
+    """Two clients with different RNG draws back off differently — the
+    anti-thundering-herd property, pinned on the delay formula."""
+    for draw, expect in ((0.0, 0.025), (1.0, 0.075)):
+        sleeps = []
+        fn, _ = _flaky(1)
+        call_with_retries("op", fn, retries=1, rng=lambda d=draw: d,
+                          sleep=sleeps.append)
+        np.testing.assert_allclose(sleeps, [expect])
+
+
+def test_retries_counted_per_op():
+    obs.enable(forensics=False)
+    try:
+        reg = obs.get_registry()
+        before = reg.counter(
+            "comm_retry_total", "transient control-plane RPC retries",
+            labels=("op",),
+        ).value(op="GetCommStatus")
+        flaky = _Flaky(2)
+        client = PipelineClient(coordinator=flaky, devices=[], comm_id=1,
+                                device_ids=[])
+        assert client.status() == pb.SUCCESS
+        after = reg.counter(
+            "comm_retry_total", "transient control-plane RPC retries",
+            labels=("op",),
+        ).value(op="GetCommStatus")
+        assert after - before == 2
+    finally:
+        obs.disable()
+
+
+class _Flaky:
+    """Coordinator stub whose GetCommStatus flakes N times, then answers."""
+
+    def __init__(self, n_failures):
+        self.n = n_failures
+
+    def GetCommStatus(self, request, timeout=None):  # noqa: N802
+        if self.n > 0:
+            self.n -= 1
+            raise _Err(grpc.StatusCode.UNAVAILABLE)
+        return pb.GetCommStatusResponse(status=pb.SUCCESS, members=[])
+
+
+# ---------------------------------------------------------------------------
+# coordinator failure listeners
+# ---------------------------------------------------------------------------
+
+
+class _DeadStub:
+    def GetDeviceMetadata(self, request, timeout=None):  # noqa: N802
+        raise _Err(grpc.StatusCode.UNAVAILABLE)
+
+
+class _LiveStub:
+    def GetDeviceMetadata(self, request, timeout=None):  # noqa: N802
+        return pb.GetDeviceMetadataResponse()
+
+    def ConfigurePeers(self, request, timeout=None):  # noqa: N802
+        return pb.ConfigurePeersResponse()
+
+
+class _Channel:
+    def close(self):
+        pass
+
+
+def test_health_loop_pushes_failure_verdicts():
+    """A probe pass that finds dead devices notifies every listener with
+    (comm_id, failed ids, alive ids) BEFORE renumbering — the push feed
+    the elastic controller's failure_feed adapter consumes."""
+    from dsml_tpu.comm.coordinator import (
+        Communicator,
+        CoordinatorConfig,
+        CoordinatorRuntime,
+        DeviceInfo,
+    )
+
+    rt = CoordinatorRuntime(CoordinatorConfig(health_interval_s=3600.0))
+    try:
+        infos = [
+            DeviceInfo(0, 10, "a:1", _LiveStub(), _Channel(), pb.DeviceMetadata()),
+            DeviceInfo(1, 11, "a:2", _DeadStub(), _Channel(), pb.DeviceMetadata()),
+        ]
+        comm = Communicator(99, infos)
+        heard = []
+        rt.add_failure_listener(lambda cid, failed, alive:
+                                heard.append((cid, failed, alive)))
+        # listener exceptions must never wedge the health loop
+        rt.add_failure_listener(lambda *a: (_ for _ in ()).throw(ValueError()))
+        rt._check_comm_health(comm)
+        assert heard == [(99, [11], [10])]
+        assert comm.status == pb.FAILED  # elastic off: pruned + failed
+    finally:
+        rt.stop()

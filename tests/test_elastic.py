@@ -126,6 +126,51 @@ def test_check_recoverable_sharded_torn(devices8):
     assert torn and "only on lost devices" in torn[0]
 
 
+def test_check_recoverable_zero2_torn_leaf(devices8):
+    """ZeRO-2 layout: params replicate (survive anything) but each rank
+    holds 1/n of the optimizer state — losing ONE fsdp rank tears the
+    sharded moments, and require_full_state refuses to continue on them
+    (the checkpoint fallback is the only honest move)."""
+    import optax as _optax
+
+    from dsml_tpu.parallel.fsdp import init_zero2
+
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    opt = _optax.adam(1e-3)
+    mesh = build_mesh(MeshSpec(dp=1, fsdp=8), devices8)
+    params, opt_state = init_zero2(model, opt, mesh)
+    # replicated params survive the loss of any single rank…
+    assert check_recoverable(params, lost_devices=devices8[-1:]) == []
+    # …but the 1/n-sharded optimizer moments do not
+    torn = check_recoverable((params, opt_state), lost_devices=devices8[-1:])
+    assert torn and all("only on lost devices" in d for d in torn)
+    with pytest.raises(RuntimeError, match="not recoverable"):
+        reconfigure(
+            model, opt, params, opt_state,
+            surviving_devices=devices8[:-1], lost_devices=devices8[-1:],
+        )
+
+
+def test_check_recoverable_whole_mesh_axis_loss(devices8):
+    """Losing an ENTIRE mesh axis (pipeline stage 1 = devices 4..7 on a
+    [pp=2, dp=2, tp=2] layout) tears every stage-sharded layer leaf —
+    while losing one dp replica of the same mesh tears nothing (each
+    pp/tp shard keeps a surviving copy)."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    opt = optax.adam(1e-3)
+    mesh8 = build_mesh(MeshSpec(pp=2, dp=2, sp=1, tp=2), devices8)
+    params, _ = init_hybrid(model, opt, mesh8, seed=0)
+    n_layer_leaves = len(jax.tree.leaves(params["layers"]))
+    torn = check_recoverable(params, lost_devices=devices8[4:])
+    assert len(torn) == n_layer_leaves  # every stacked leaf lost a stage
+    # non-layer leaves (wte/wpe/ln_f) replicate over pp: none flagged
+    assert all("only on lost devices" in d for d in torn)
+    # contrast: one dp replica (devices {2,3,6,7}) is fully recoverable
+    assert check_recoverable(params, [devices8[i] for i in (2, 3, 6, 7)]) == []
+
+
 def test_policy_no_shrink_fails_fast(devices8):
     model = GPT2(GPT2Config.tiny())
     with pytest.raises(RuntimeError, match="allow_shrink=False"):

@@ -822,3 +822,71 @@ def test_llama_kvquant_turbo_composition_matches_generate():
     for rid, p in zip(rids, prompts):
         assert out[rid] == _reference(model, params, p, 14), rid
     assert srv.n_turbo_ticks > 0
+
+
+def test_queue_cap_sheds_explicitly():
+    """max_queue: overload becomes an explicit QueueFull + a
+    serving_shed_total count instead of an unbounded queue — and shed
+    requests leave the admitted ones untouched (they still drain with
+    reference-identical tokens)."""
+    from dsml_tpu import obs
+    from dsml_tpu.serving import QueueFull
+
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(0)
+    srv = ContinuousBatcher(model, params, n_slots=1, max_queue=2)
+    prompts = _prompts(cfg, [5, 6, 7, 8])
+    obs.enable(forensics=False)
+    try:
+        reg = obs.get_registry()
+        shed = reg.counter(
+            "serving_shed_total", "requests rejected at submit by the queue cap"
+        )
+        before = shed.value()
+        rids = [srv.submit(p, 3) for p in prompts[:2]]  # queue holds 2
+        with pytest.raises(QueueFull, match="cap"):
+            srv.submit(prompts[2], 3)
+        assert shed.value() - before == 1
+        assert srv.n_queued == 2  # the shed request left no residue
+        # draining frees queue space: submit succeeds again afterwards
+        out = srv.run()
+        rids.append(srv.submit(prompts[3], 3))
+        out.update(srv.run())
+        for rid, p in zip(rids, [prompts[0], prompts[1], prompts[3]]):
+            assert out[rid] == _reference(model, params, p, 3)
+    finally:
+        obs.disable()
+
+
+def test_queue_cap_validation_and_default_unbounded():
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(0)
+    with pytest.raises(ValueError, match="max_queue"):
+        ContinuousBatcher(model, params, max_queue=-1)
+    srv = ContinuousBatcher(model, params, n_slots=1)  # default: unbounded
+    for p in _prompts(cfg, [4] * 12):
+        srv.submit(p, 2)
+    assert srv.n_queued == 12
+
+
+def test_abandon_evacuates_unfinished_requests():
+    """abandon() returns every queued + active request (the replica-failure
+    evacuation) and resets the scheduler; finished results stay
+    collectable, and the batcher serves fresh work afterwards."""
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(0)
+    srv = ContinuousBatcher(model, params, n_slots=2)
+    prompts = _prompts(cfg, [5, 6, 7])
+    done_rid = srv.submit(prompts[0], 1)   # retires at prefill
+    live_rids = [srv.submit(prompts[1], 8), srv.submit(prompts[2], 8)]
+    srv.step()  # admits everything; budget-1 request already retired
+    evacuated = srv.abandon()
+    assert sorted(r.rid for r in evacuated) == sorted(live_rids)
+    assert srv.n_active == 0 and srv.n_queued == 0 and srv.n_pending == 0
+    assert done_rid in srv.collect()  # finished work survives the evacuation
+    # the reset batcher still serves correctly (cache garbage overwritten)
+    rid = srv.submit(prompts[1], 4)
+    assert srv.run()[rid] == _reference(model, params, prompts[1], 4)

@@ -70,3 +70,80 @@ def test_shard_batches_covers_epoch():
     assert seen == [32, 32, 32]  # drop_remainder
     all_items = np.concatenate([yb for _, yb in shard_batches(x, y, 50, seed=1)])
     assert len(set(all_items.tolist())) == 100  # shuffled, no duplicates
+
+
+def test_mid_epoch_save_and_resume_bit_identical(dp_mesh8, tmp_path):
+    """save_every_steps: a run preempted MID-EPOCH resumes from the
+    step-granularity checkpoint with the data-loader position intact —
+    final params bit-identical to the uninterrupted run (the elastic
+    controller's resume contract, now at the Trainer level too). Also
+    pins the new exception-path flush: the killed run's async saves are
+    committed by the time train() has raised."""
+    data = synthetic_classification(512, features=16, classes=4, seed=0)
+    ck = str(tmp_path / "run")
+    cfg = dict(epochs=2, batch_size=64, lr=0.05, seed=3,
+               save_every_steps=3, keep_checkpoints=0)
+    # synthetic_classification holds out a test split → 448 train rows →
+    # steps_per_epoch = 7; kill after 11 completed steps (epoch 2, batch 4)
+
+    class _Preempted(RuntimeError):
+        pass
+
+    class _KilledTrainer(Trainer):
+        def _build(self, steps_per_epoch):
+            optimizer = super()._build(steps_per_epoch)
+            inner, calls = self._step_fn, {"n": 0}
+
+            def wrapped(params, opt_state, x, y):
+                calls["n"] += 1
+                if calls["n"] > 11:
+                    raise _Preempted("simulated preemption")
+                return inner(params, opt_state, x, y)
+
+            self._step_fn = wrapped
+            return optimizer
+
+    model = MLP(sizes=(16, 32, 4))
+    uninterrupted, _, _ = Trainer(
+        model, TrainConfig(**cfg), mesh=dp_mesh8
+    ).train(data)
+
+    with pytest.raises(_Preempted):
+        _KilledTrainer(
+            model, TrainConfig(checkpoint_dir=ck, **cfg), mesh=dp_mesh8
+        ).train(data)
+    from dsml_tpu.checkpoint import CheckpointManager
+
+    with CheckpointManager(ck) as m:
+        # latest mid-epoch save: global step 9 = epoch 2, 2 batches
+        # consumed (7 was the epoch-1 boundary save; the exception-path
+        # close flushed the async commit)
+        assert m.latest_step() == 9
+        assert m.iterator_state() == {"epoch": 2, "consumed": 2}
+
+    resumed, hist, _ = Trainer(
+        model, TrainConfig(checkpoint_dir=ck, resume=True, **cfg),
+        mesh=dp_mesh8,
+    ).train(data)
+    assert [h["epoch"] for h in hist] == [2]  # only the resumed epoch
+    for k in uninterrupted:
+        np.testing.assert_array_equal(
+            np.asarray(uninterrupted[k]), np.asarray(resumed[k]), err_msg=k
+        )
+
+
+def test_epoch_boundary_resume_unchanged_by_default(dp_mesh8, tmp_path):
+    """save_every_steps=0 (default) keeps the historical epoch-id
+    checkpoint scheme byte-for-byte: ids are epoch numbers and resume
+    starts at the next epoch."""
+    data = synthetic_classification(256, features=8, classes=4, seed=1)
+    ck = str(tmp_path / "run")
+    model = MLP(sizes=(8, 16, 4))
+    Trainer(model, TrainConfig(epochs=2, batch_size=64, lr=0.05,
+                               checkpoint_dir=ck, seed=1),
+            mesh=dp_mesh8).train(data)
+    from dsml_tpu.checkpoint import CheckpointManager
+
+    with CheckpointManager(ck) as m:
+        assert m.latest_step() == 2  # epoch ids, not step ids
+        assert m.iterator_state() == {"epoch": 2, "consumed": 0}
