@@ -37,7 +37,7 @@ import numpy as np
 from dsml_tpu.comm import rpc
 from dsml_tpu.comm.device_server import DeviceError, local_device
 from dsml_tpu.comm.proto import gpu_sim_pb2 as pb
-from dsml_tpu.obs import get_registry, observe_collective_latency_ms
+from dsml_tpu.obs import get_registry, observe_collective_latency_ms, span
 from dsml_tpu.obs import flight_recorder, hangwatch
 from dsml_tpu.ops.collectives import ReduceOp, make_stacked_all_reduce
 from dsml_tpu.utils.config import Config, field as cfg_field
@@ -143,6 +143,34 @@ class CoordinatorRuntime:
         exceptions are logged, never allowed to wedge the health loop."""
         with self._lock:
             self._failure_listeners.append(fn)
+
+    def failure_feed(self):
+        """A LIVE feed for ``runtime.controller.ElasticController``'s
+        ``failure_feed=`` hook: registers an internal listener and returns
+        a zero-arg callable that drains the device ids the health loop has
+        declared dead since the last call. The push verdict becomes the
+        controller's poll — the glue that turns a coordinator death
+        sentence into a ``DeviceLost`` signal instead of a hung step
+        (closes the ROADMAP item: tests previously used injected feeds
+        only)."""
+        import collections
+
+        pending: collections.deque = collections.deque()
+
+        def on_failure(comm_id, failed_ids, alive_ids):
+            pending.extend(failed_ids)  # deque.extend is thread-safe
+
+        self.add_failure_listener(on_failure)
+
+        def feed() -> list:
+            out = []
+            while True:
+                try:
+                    out.append(pending.popleft())
+                except IndexError:
+                    return out
+
+        return feed
 
     # ---- communicator lifecycle -----------------------------------------------
 
@@ -312,7 +340,13 @@ class CoordinatorRuntime:
                 )
         t0 = time.perf_counter()
         try:
-            run()
+            # wire_op span: the coordinator lane of the STITCHED cluster
+            # timeline — device-side device_memcpy/device_forward spans from
+            # the device servers' own processes land inside this interval
+            # once clock offsets are aligned (obs.cluster.stitch_traces)
+            with span("wire_op", comm=comm_id, count=count,
+                      algorithm=self.config.ring_algorithm):
+                run()
             wall_s = time.perf_counter() - t0
             # per-op latency, labeled by the algorithm that actually ran —
             # the accounting surface the reference reported as totalTimeMs
@@ -794,6 +828,11 @@ def serve_coordinator(
     runtime = CoordinatorRuntime(config)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
     rpc.add_coordinator_servicer(CoordinatorServicer(runtime), server)
+    # cluster obs plane (same port): the aggregator pulls the coordinator's
+    # registry/trace snapshot — wire-op latency, health probes, stragglers
+    from dsml_tpu.obs.cluster import ObsServicer, current_role
+
+    rpc.add_obs_servicer(ObsServicer(current_role("coordinator")), server)
     bound = server.add_insecure_port(f"{host}:{port}")
     server.start()
     return CoordinatorHandle(runtime, server, f"{host}:{bound}")

@@ -32,6 +32,7 @@ import numpy as np
 from dsml_tpu.comm import rpc
 from dsml_tpu.comm.proto import gpu_sim_pb2 as pb
 from dsml_tpu.models.mlp import MLP
+from dsml_tpu.obs import span
 from dsml_tpu.utils.logging import get_logger
 
 log = get_logger("device")
@@ -453,13 +454,20 @@ class DeviceServicer:
         return pb.GetStreamStatusResponse(status=status)
 
     def Memcpy(self, request, context):  # noqa: N802
+        # device-side execution span: in the STITCHED cluster timeline this
+        # lane shows what the device actually did inside the coordinator's
+        # wire_op span (clock-offset-aligned, docs/OBSERVABILITY.md § Cluster)
         try:
             if request.HasField("hostToDevice"):
                 h2d = request.hostToDevice
-                self.rt.memcpy_h2d(h2d.dstMemAddr.value, h2d.hostSrcData)
+                with span("device_memcpy", direction="h2d",
+                          device=self.rt.device_id):
+                    self.rt.memcpy_h2d(h2d.dstMemAddr.value, h2d.hostSrcData)
                 return pb.MemcpyResponse(hostToDevice=pb.MemcpyHostToDeviceResponse(success=True))
             d2h = request.deviceToHost
-            data = self.rt.memcpy_d2h(d2h.srcMemAddr.value, d2h.numBytes or None)
+            with span("device_memcpy", direction="d2h",
+                      device=self.rt.device_id):
+                data = self.rt.memcpy_d2h(d2h.srcMemAddr.value, d2h.numBytes or None)
             return pb.MemcpyResponse(deviceToHost=pb.MemcpyDeviceToHostResponse(dstData=data))
         except DeviceError as e:
             self._abort(context, e)
@@ -470,14 +478,16 @@ class DeviceServicer:
 
     def RunForward(self, request, context):  # noqa: N802
         try:
-            n = self.rt.run_forward(request.inputAddr.value, request.outputAddr.value)
+            with span("device_forward", device=self.rt.device_id):
+                n = self.rt.run_forward(request.inputAddr.value, request.outputAddr.value)
         except DeviceError as e:
             self._abort(context, e)
         return pb.RunForwardResponse(success=True, outputBytes=n)
 
     def RunBackward(self, request, context):  # noqa: N802
         try:
-            self.rt.run_backward(request.gradientAddr.value)
+            with span("device_backward", device=self.rt.device_id):
+                self.rt.run_backward(request.gradientAddr.value)
         except DeviceError as e:
             self._abort(context, e)
         return pb.RunBackwardResponse(success=True)
@@ -505,6 +515,11 @@ def serve_device(
     runtime = DeviceRuntime(device_id, mem_size=mem_size, jax_device=jax_device, model=model)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
     rpc.add_device_servicer(DeviceServicer(runtime), server)
+    # cluster obs plane on the SAME port: the aggregator pulls this
+    # process's registry/trace snapshot over the channel it already has
+    from dsml_tpu.obs.cluster import ObsServicer, current_role
+
+    rpc.add_obs_servicer(ObsServicer(current_role("device_server")), server)
     bound = server.add_insecure_port(f"{host}:{port}")
     server.start()
     runtime.bound_address = f"{host}:{bound}"

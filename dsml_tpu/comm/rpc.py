@@ -41,9 +41,22 @@ _COORDINATOR_METHODS = {
     "Memcpy": ("uu", pb.MemcpyRequest, pb.MemcpyResponse),
 }
 
+# Observability plane — an EXTENSION service carrying raw JSON bytes
+# (req/resp class None = no protobuf codec: grpc passes bytes through).
+# The reference proto stays byte-for-byte untouched; reference peers never
+# call it, and our peers that lack it just fail the obs scrape, never the
+# data plane. Workers (device servers, the coordinator) attach it to the
+# grpc.Server they already run, so the cluster aggregator pulls snapshots
+# over the SAME port/channel as the gpu_sim traffic.
+_OBS_METHODS = {
+    "PullSnapshot": ("uu", None, None),
+    "PushSnapshot": ("uu", None, None),
+}
+
 _SERVICES = {
     "gpu_sim.GPUDevice": _DEVICE_METHODS,
     "gpu_sim.GPUCoordinator": _COORDINATOR_METHODS,
+    "dsml_obs.ObsPlane": _OBS_METHODS,
 }
 
 
@@ -53,13 +66,15 @@ def add_servicer_to_server(service_name: str, servicer, server: grpc.Server) -> 
     handlers = {}
     for name, (arity, req_cls, resp_cls) in methods.items():
         fn = getattr(servicer, name)
+        deser = req_cls.FromString if req_cls is not None else None
+        ser = resp_cls.SerializeToString if resp_cls is not None else None
         if arity == "uu":
             handlers[name] = grpc.unary_unary_rpc_method_handler(
-                fn, request_deserializer=req_cls.FromString, response_serializer=resp_cls.SerializeToString
+                fn, request_deserializer=deser, response_serializer=ser
             )
         else:
             handlers[name] = grpc.stream_unary_rpc_method_handler(
-                fn, request_deserializer=req_cls.FromString, response_serializer=resp_cls.SerializeToString
+                fn, request_deserializer=deser, response_serializer=ser
             )
     server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(service_name, handlers),))
 
@@ -71,13 +86,15 @@ class _Stub:
         self._channel = channel  # retained so owners can close() on replace
         for name, (arity, req_cls, resp_cls) in _SERVICES[service_name].items():
             path = f"/{service_name}/{name}"
+            ser = req_cls.SerializeToString if req_cls is not None else None
+            deser = resp_cls.FromString if resp_cls is not None else None
             if arity == "uu":
                 callable_ = channel.unary_unary(
-                    path, request_serializer=req_cls.SerializeToString, response_deserializer=resp_cls.FromString
+                    path, request_serializer=ser, response_deserializer=deser
                 )
             else:
                 callable_ = channel.stream_unary(
-                    path, request_serializer=req_cls.SerializeToString, response_deserializer=resp_cls.FromString
+                    path, request_serializer=ser, response_deserializer=deser
                 )
             setattr(self, name, callable_)
 
@@ -90,9 +107,17 @@ def coordinator_stub(channel: grpc.Channel) -> _Stub:
     return _Stub(channel, "gpu_sim.GPUCoordinator")
 
 
+def obs_stub(channel: grpc.Channel) -> _Stub:
+    return _Stub(channel, "dsml_obs.ObsPlane")
+
+
 def add_device_servicer(servicer, server: grpc.Server) -> None:
     add_servicer_to_server("gpu_sim.GPUDevice", servicer, server)
 
 
 def add_coordinator_servicer(servicer, server: grpc.Server) -> None:
     add_servicer_to_server("gpu_sim.GPUCoordinator", servicer, server)
+
+
+def add_obs_servicer(servicer, server: grpc.Server) -> None:
+    add_servicer_to_server("dsml_obs.ObsPlane", servicer, server)

@@ -32,6 +32,20 @@ Failure forensics (``docs/OBSERVABILITY.md`` § Failure forensics):
   (``DSML_HANGWATCH``): trainer per loss-sync window, coordinator per
   wire op, checkpoint writer per commit; expiry dumps stacks + a bundle.
 
+Cluster plane (``docs/OBSERVABILITY.md`` § Cluster):
+
+- :mod:`~dsml_tpu.obs.cluster` — cross-process aggregation: identity-
+  stamped snapshots, exact-sum counter / bucket-wise histogram merge into
+  ONE fleet exposition with ``host``/``pid``/``role`` labels, fleet
+  goodput + straggler ranking, and Chrome-trace stitching with
+  handshake-based clock-offset alignment (HTTP scrape of
+  ``start_metrics_server``'s ``/cluster.json`` or gRPC pull/push over the
+  ``comm/`` ObsPlane service).
+- :mod:`~dsml_tpu.obs.regress` — perf-regression gate over the committed
+  ``BENCH_r*.json`` history (median ± k·MAD noise bands); ``python -m
+  dsml_tpu.obs.regress`` exits nonzero on regression and exports the
+  calibrated collective-latency profile for the cost-model planner.
+
 Metric names, label sets, and the span taxonomy are specified in
 ``docs/OBSERVABILITY.md``.
 """
@@ -86,7 +100,23 @@ __all__ = [
     "FlightRecorder", "get_flight_recorder", "dump_postmortem",
     "SentinelConfig", "SentinelTripped", "TrainingSentinels",
     "HangWatch", "TrailingDeadline", "get_hangwatch",
+    "ClockSync", "ClusterAggregator", "merge_snapshots", "snapshot",
+    "stitch_traces",
 ]
+
+# cluster-plane names resolve lazily (PEP 562): ``python -m
+# dsml_tpu.obs.cluster`` would otherwise warn about the module being
+# imported as a side effect of its own package __init__
+_CLUSTER_NAMES = ("ClockSync", "ClusterAggregator", "merge_snapshots",
+                  "snapshot", "stitch_traces")
+
+
+def __getattr__(name: str):
+    if name in _CLUSTER_NAMES:
+        from dsml_tpu.obs import cluster as _cluster
+
+        return getattr(_cluster, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def enable(forensics: bool = True) -> None:

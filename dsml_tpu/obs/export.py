@@ -5,9 +5,11 @@
   rotation-safe: ``max_bytes`` caps the file, rotating ``path`` →
   ``path.1`` atomically so a long-running trainer cannot fill a disk.
 - :func:`start_metrics_server` — OPT-IN in-process HTTP endpoint serving
-  the registry's Prometheus text at ``/metrics`` and the JSON snapshot at
-  ``/metrics.json`` (scrape-able by Prometheus or curl; nothing listens
-  unless a caller asks).
+  the registry's Prometheus text at ``/metrics``, the JSON snapshot at
+  ``/metrics.json``, and the identity-stamped CLUSTER snapshot (metrics +
+  Chrome trace + clock reading) at ``/cluster.json`` — the scrape surface
+  ``obs.cluster.ClusterAggregator`` merges fleet-wide (scrape-able by
+  Prometheus or curl; nothing listens unless a caller asks).
 """
 
 from __future__ import annotations
@@ -86,10 +88,17 @@ class MetricsServer:
 
 
 def start_metrics_server(registry: Registry | None = None, port: int = 0,
-                         host: str = "127.0.0.1") -> MetricsServer:
+                         host: str = "127.0.0.1",
+                         role: str | None = None,
+                         tracer=None) -> MetricsServer:
     """Serve ``registry`` on a daemon thread. ``port=0`` picks a free
-    port (read it back from the handle). Raises :class:`ObsUnavailable`
-    when the port cannot be bound, with the conflicting address named."""
+    port (read it back from the handle). ``role`` labels this process in
+    ``/cluster.json`` snapshots (default: ``DSML_OBS_ROLE``) and
+    ``tracer`` pairs them with the matching span trace — pass it whenever
+    ``registry`` is a private instance, or the snapshot would couple
+    private metrics with the GLOBAL tracer's unrelated spans. Raises
+    :class:`ObsUnavailable` when the port cannot be bound, with the
+    conflicting address named."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     reg = registry if registry is not None else get_registry()
@@ -101,6 +110,13 @@ def start_metrics_server(registry: Registry | None = None, port: int = 0,
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif self.path.split("?")[0] == "/metrics.json":
                 body = json.dumps(reg.collect()).encode()
+                ctype = "application/json"
+            elif self.path.split("?")[0] == "/cluster.json":
+                from dsml_tpu.obs.cluster import snapshot
+
+                body = json.dumps(
+                    snapshot(role=role, registry=reg, tracer=tracer)
+                ).encode()
                 ctype = "application/json"
             else:
                 self.send_error(404)

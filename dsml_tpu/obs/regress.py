@@ -1,0 +1,450 @@
+"""Perf-regression gate over the committed BENCH history.
+
+``BENCH_r01..r05.json`` record five rounds of bench output, but nothing
+machine-checks a fresh run against them — "did this PR make the benches
+worse?" has been a human squinting at JSON. This module is the
+machine-checkable answer:
+
+- :func:`extract_metrics` — best-effort metric extraction from every
+  artifact shape the history actually contains: full bench records with
+  ``parsed`` payloads, rc=124 timeouts with bare tails, and 2000-byte
+  tail TRUNCATIONS that cut the final JSON line mid-record (r03/r05) —
+  a strict parser would call three of five rounds empty;
+- :func:`compare` — per-metric noise bands (median ± k·MAD over the
+  history, with a relative floor so an all-identical history doesn't
+  produce a zero-width band) and a direction table (tokens/s up is good,
+  step-ms up is bad; config constants like batch sizes are never gated);
+- :func:`export_profile` — the calibrated collective-latency constants
+  (ring/naive p50, e2e wire path, payload) as a machine-readable profile
+  JSON for the ROADMAP's SCALE-Sim-style cost-model planner, sourced
+  from the bench history and/or an aggregated cluster snapshot's
+  ``collective_latency_ms`` histograms;
+- ``python -m dsml_tpu.obs.regress`` — the CI gate: exits nonzero on a
+  regression, 0 clean, 2 when nothing was parseable; ``--report-only``
+  always exits 0 but still writes the report artifact.
+
+Thresholds and the direction table are documented in
+``docs/OBSERVABILITY.md`` § Perf-regression gate.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+__all__ = [
+    "compare",
+    "export_profile",
+    "extract_metrics",
+    "main",
+    "metric_direction",
+    "noise_band",
+    "profile_from_merged",
+]
+
+REPORT_SCHEMA = "dsml.obs.regress_report/1"
+PROFILE_SCHEMA = "dsml.obs.collective_profile/1"
+
+# defaults; the CLI exposes all three
+DEFAULT_K = 5.0          # band half-width in MADs
+DEFAULT_REL_FLOOR = 0.10  # ... but never narrower than ±10% of |median|
+DEFAULT_MIN_HISTORY = 3   # fewer samples -> "insufficient_history", not gated
+
+# a history this noisy carries no regression signal: MAD/|median| above
+# this ratio marks the metric "too_noisy" and exempts it from gating
+# (BENCH_r01's warm-cache mnist row is 270x its successors — a band wide
+# enough to admit that spread would admit anything)
+NOISE_CEILING = 0.5
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+# one positional token stream over (possibly truncated) JSON text:
+# headline names ('"metric": "NAME"') and numeric '"key": value' pairs —
+# the trailing lookahead rejects a number cut off by the tail boundary
+_TOKEN_RE = re.compile(
+    r'"metric":\s*"([A-Za-z_][A-Za-z0-9_]*)"'
+    r'|"([A-Za-z_][A-Za-z0-9_]*)":\s*(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)'
+    r"(?=\s*[,}\]])"
+)
+# bookkeeping keys that are record structure, not metrics
+_STRUCTURE_KEYS = frozenset({"n", "rc", "time", "value"})
+
+
+def _scan_text(text: str, out: dict) -> None:
+    """Fold numeric pairs from (possibly truncated) JSON text into ``out``
+    — later occurrences win, matching "the final emitted line is the
+    record". A ``"value": V`` maps onto the most recent PRECEDING
+    ``"metric": NAME`` only: a truncated multi-record tail can cut one
+    record's value off entirely, and last-headline-wins would then hand
+    another record's value to the wrong metric."""
+    headline = None
+    for m in _TOKEN_RE.finditer(text):
+        name, key, num = m.groups()
+        if name is not None:
+            headline = name
+            continue
+        if key == "value":
+            if headline is not None:
+                out[headline] = float(num)
+                headline = None  # one headline, one value
+            continue
+        if key in _STRUCTURE_KEYS:
+            continue
+        out[key] = float(num)
+
+
+def _flatten_numeric(obj, out: dict) -> None:
+    """Collect numeric leaves of a nested dict keyed by their LEAF name
+    (bench extras are flat and uniquely named; nested wrappers like the
+    evidence file's rows just add structure)."""
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            if isinstance(v, bool):
+                continue
+            if isinstance(v, (int, float)):
+                if isinstance(k, str) and k not in _STRUCTURE_KEYS:
+                    out[str(k)] = float(v)
+            elif isinstance(v, (dict, list)):
+                _flatten_numeric(v, out)
+    elif isinstance(obj, list):
+        for v in obj:
+            _flatten_numeric(v, out)
+
+
+def extract_metrics(source) -> dict[str, float]:
+    """{metric name: value} from a bench artifact.
+
+    Accepts: a BENCH record dict (``{n, cmd, rc, tail, parsed}``), a
+    ``{"metric":..., "extras": {...}}`` headline dict, any nested dict of
+    numbers (``BENCH_TPU_evidence.json``), raw bench stdout text, or a
+    path to a JSON/text file holding any of those."""
+    if isinstance(source, str):
+        if os.path.exists(source):
+            with open(source) as f:
+                text = f.read()
+            try:
+                source = json.loads(text)
+            except ValueError:
+                source = text
+        # fall through with text or the decoded object
+    out: dict[str, float] = {}
+    if isinstance(source, str):
+        _scan_text(source, out)
+        return out
+    if isinstance(source, dict) and ("tail" in source or "parsed" in source):
+        # BENCH record: tail first (truncated, older), parsed wins (complete)
+        if isinstance(source.get("tail"), str):
+            _scan_text(source["tail"], out)
+        parsed = source.get("parsed")
+        if isinstance(parsed, dict):
+            _flatten_numeric(parsed.get("extras", {}), out)
+            if isinstance(parsed.get("metric"), str) and \
+                    isinstance(parsed.get("value"), (int, float)):
+                out[parsed["metric"]] = float(parsed["value"])
+        return out
+    if isinstance(source, dict):
+        if isinstance(source.get("metric"), str) and \
+                isinstance(source.get("value"), (int, float)):
+            out[source["metric"]] = float(source["value"])
+        _flatten_numeric(source.get("extras", source), out)
+        return out
+    raise TypeError(f"cannot extract metrics from {type(source).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# direction table
+# ---------------------------------------------------------------------------
+
+# (predicate order matters: first hit wins)
+_NOT_A_METRIC = (
+    "reference_", "_devices", "_batch", "batch", "_epochs", "epochs_",
+    "_steps", "steps_per", "_seed", "_vocab", "_payload", "payload_",
+    "_bytes", "_mb", "_requests", "n_requests", "_quantum", "_window",
+    "_events", "_count", "capture_", "_buckets", "_replicas", "timed_",
+    "warmup_", "_remat",
+)
+_HIGHER_BETTER = (
+    "samples_per_sec", "tokens_per_sec", "tokens_per_s", "goodput",
+    "accuracy", "mfu", "speedup", "coverage_pct",
+)
+_LOWER_BETTER_SUFFIX = ("_ms", "_s", "_sec", "_pct", "_ppl")
+_LOWER_BETTER_CONTAINS = ("loss", "overhead", "stall", "latency")
+
+
+def metric_direction(name: str) -> str | None:
+    """"higher" / "lower" = which way is GOOD; None = not a perf metric
+    (config constants, provenance counts) — never gated."""
+    low = name.lower()
+    if any(t in low for t in _NOT_A_METRIC):
+        return None
+    if any(t in low for t in _HIGHER_BETTER):
+        return "higher"
+    if any(t in low for t in _LOWER_BETTER_CONTAINS):
+        return "lower"
+    if low.endswith(_LOWER_BETTER_SUFFIX):
+        return "lower"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# noise bands + comparison
+# ---------------------------------------------------------------------------
+
+
+def _median(vals: list[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def noise_band(history: list[float], k: float = DEFAULT_K,
+               rel_floor: float = DEFAULT_REL_FLOOR) -> dict:
+    """median ± max(k·MAD, rel_floor·|median|) — MAD is robust to the
+    history's outlier rounds (a dead-tunnel CPU fallback must not drag
+    the center), the relative floor keeps an all-identical history from
+    flagging any measurement jitter as a regression."""
+    med = _median(history)
+    mad = _median([abs(v - med) for v in history])
+    half = max(k * mad, rel_floor * abs(med))
+    return {
+        "median": med, "mad": mad, "half_width": half,
+        "lo": med - half, "hi": med + half, "n": len(history),
+        "noise_ratio": (mad / abs(med)) if med else None,
+    }
+
+
+def compare(fresh: dict[str, float], history: list[dict[str, float]],
+            k: float = DEFAULT_K, rel_floor: float = DEFAULT_REL_FLOOR,
+            min_history: int = DEFAULT_MIN_HISTORY) -> dict:
+    """Gate ``fresh`` against per-metric noise bands over ``history``.
+
+    Per metric: ``regression`` (fresh beyond the band on the BAD side),
+    ``improved`` (beyond on the good side), ``ok`` (inside),
+    ``insufficient_history`` (< min_history samples), ``too_noisy``
+    (MAD/|median| > NOISE_CEILING — no signal), ``not_gated`` (no
+    direction). The report is the artifact; ``regressions`` is the exit
+    verdict."""
+    rows: dict[str, dict] = {}
+    regressions: list[str] = []
+    for name in sorted(fresh):
+        value = fresh[name]
+        samples = [h[name] for h in history if name in h]
+        direction = metric_direction(name)
+        row: dict = {"fresh": value, "direction": direction,
+                     "n_history": len(samples)}
+        if direction is None:
+            row["status"] = "not_gated"
+        elif len(samples) < min_history:
+            row["status"] = "insufficient_history"
+        else:
+            band = noise_band(samples, k=k, rel_floor=rel_floor)
+            row.update(band)
+            ratio = band["noise_ratio"]
+            if ratio is not None and ratio > NOISE_CEILING:
+                row["status"] = "too_noisy"
+            elif direction == "higher" and value < band["lo"]:
+                row["status"] = "regression"
+            elif direction == "lower" and value > band["hi"]:
+                row["status"] = "regression"
+            elif direction == "higher" and value > band["hi"]:
+                row["status"] = "improved"
+            elif direction == "lower" and value < band["lo"]:
+                row["status"] = "improved"
+            else:
+                row["status"] = "ok"
+        if row["status"] == "regression":
+            regressions.append(name)
+        rows[name] = row
+    counts: dict[str, int] = {}
+    for row in rows.values():
+        counts[row["status"]] = counts.get(row["status"], 0) + 1
+    return {
+        "schema": REPORT_SCHEMA,
+        "params": {"k": k, "rel_floor": rel_floor,
+                   "min_history": min_history,
+                   "noise_ceiling": NOISE_CEILING},
+        "n_history_records": len(history),
+        "metrics": rows,
+        "counts": counts,
+        "regressions": regressions,
+    }
+
+
+# ---------------------------------------------------------------------------
+# calibrated collective-latency profile (cost-model planner input)
+# ---------------------------------------------------------------------------
+
+# bench keys that ARE calibration constants for the planner's cost model
+_PROFILE_PREFIXES = ("allreduce_", "bucket_sweep_", "v8_")
+_PROFILE_EXACT = ("serving_host_rtt_ms",)
+_PROFILE_SUFFIXES = ("_step_ms",)
+
+
+def _is_profile_key(name: str) -> bool:
+    return (name.startswith(_PROFILE_PREFIXES)
+            or name in _PROFILE_EXACT
+            or name.endswith(_PROFILE_SUFFIXES))
+
+
+def export_profile(fresh: dict[str, float],
+                   history: list[dict[str, float]]) -> dict:
+    """The measured collective/step-time constants, centered by history
+    median (robust to outlier rounds) with the fresh sample alongside —
+    the calibration input the ROADMAP's auto-parallel planner consumes
+    instead of re-measuring."""
+    constants: dict[str, dict] = {}
+    names = {n for n in fresh if _is_profile_key(n)}
+    for h in history:
+        names.update(n for n in h if _is_profile_key(n))
+    for name in sorted(names):
+        samples = [h[name] for h in history if name in h]
+        entry: dict = {}
+        if name in fresh:
+            entry["fresh"] = fresh[name]
+        if samples:
+            entry["median"] = _median(samples)
+            entry["mad"] = _median(
+                [abs(v - entry["median"]) for v in samples]
+            )
+            entry["n"] = len(samples)
+        constants[name] = entry
+    derived: dict[str, float] = {}
+    ring = constants.get("allreduce_ring_p50_ms", {}).get("median")
+    payload = constants.get("allreduce_payload_mb", {}).get("median")
+    e2e = constants.get("allreduce_e2e_p50_ms", {}).get("median")
+    if ring is not None and payload:
+        derived["ring_ms_per_mb"] = ring / payload
+    if e2e is not None and ring is not None:
+        # wire-path fixed cost: gRPC hops + host staging beyond the
+        # on-mesh reduction itself
+        derived["wire_overhead_ms"] = max(e2e - ring, 0.0)
+    return {"schema": PROFILE_SCHEMA, "constants": constants,
+            "derived": derived}
+
+
+def profile_from_merged(merged) -> dict:
+    """Calibration constants from an AGGREGATED cluster view's
+    ``collective_latency_ms{algorithm,axis}`` fleet histograms — the
+    cross-process measurement path (ISSUE: the cost model "must be
+    calibrated from aggregated measured collective-latency histograms")."""
+    from dsml_tpu.obs.cluster import estimate_quantile
+
+    constants: dict[str, dict] = {}
+    for rec in merged.collect():
+        if rec["name"] != "collective_latency_ms:fleet":
+            continue
+        labels = rec["labels"]
+        bounds = tuple(b for b in rec["buckets"] if b != "+Inf")
+        key = "collective_{algorithm}_{axis}".format(
+            algorithm=labels.get("algorithm", "unknown"),
+            axis=labels.get("axis", "unknown"),
+        )
+        constants[key] = {
+            "count": rec["count"],
+            "mean_ms": (rec["sum"] / rec["count"]) if rec["count"] else None,
+            "p50_ms": estimate_quantile(bounds, rec["buckets"], 0.5),
+            "p90_ms": estimate_quantile(bounds, rec["buckets"], 0.9),
+        }
+    return {"schema": PROFILE_SCHEMA, "constants": constants, "derived": {}}
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _load_history(patterns: list[str]) -> tuple[list[str], list[dict]]:
+    paths: list[str] = []
+    for pat in patterns:
+        hits = sorted(glob.glob(pat))
+        paths.extend(hits if hits else ([pat] if os.path.exists(pat) else []))
+    records = []
+    used = []
+    for p in paths:
+        metrics = extract_metrics(p)
+        if metrics:
+            records.append(metrics)
+            used.append(p)
+    return used, records
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m dsml_tpu.obs.regress",
+        description="compare a fresh bench record against the BENCH_r*.json "
+        "history with per-metric noise bands; exit 1 on regression",
+    )
+    ap.add_argument("--fresh", default=None,
+                    help="fresh bench artifact (JSON record or raw stdout); "
+                    "default: the newest history file (self-check mode)")
+    ap.add_argument("--history", nargs="*", default=["BENCH_r*.json"],
+                    help="history files/globs (default: BENCH_r*.json)")
+    ap.add_argument("--k", type=float, default=DEFAULT_K,
+                    help=f"band half-width in MADs (default {DEFAULT_K})")
+    ap.add_argument("--rel-floor", type=float, default=DEFAULT_REL_FLOOR,
+                    help="minimum band half-width as a fraction of |median| "
+                    f"(default {DEFAULT_REL_FLOOR})")
+    ap.add_argument("--min-history", type=int, default=DEFAULT_MIN_HISTORY,
+                    help="samples required before a metric is gated "
+                    f"(default {DEFAULT_MIN_HISTORY})")
+    ap.add_argument("--report", default=None,
+                    help="write the full comparison report JSON here")
+    ap.add_argument("--profile", default=None,
+                    help="write the calibrated collective-latency profile "
+                    "JSON here (cost-model planner input)")
+    ap.add_argument("--report-only", action="store_true",
+                    help="always exit 0 (CI advisory mode); the report still "
+                    "records the verdict")
+    args = ap.parse_args(argv)
+
+    used, history = _load_history(args.history)
+    if not history:
+        print(f"regress: no parseable history from {args.history}")
+        return 2
+    if args.fresh is not None:
+        fresh = extract_metrics(args.fresh)
+        fresh_src = args.fresh
+    else:
+        fresh = history[-1]
+        fresh_src = used[-1] + " (self-check)"
+    if not fresh:
+        print(f"regress: nothing parseable in fresh artifact {fresh_src}")
+        return 2
+
+    report = compare(fresh, history, k=args.k, rel_floor=args.rel_floor,
+                     min_history=args.min_history)
+    report["fresh_source"] = fresh_src
+    report["history_sources"] = used
+    report["report_only"] = bool(args.report_only)
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    if args.profile:
+        with open(args.profile, "w") as f:
+            json.dump(export_profile(fresh, history), f, indent=2,
+                      sort_keys=True)
+
+    counts = report["counts"]
+    print(f"regress: {len(fresh)} fresh metrics vs {len(history)} history "
+          f"records ({used[0]}..{used[-1]}): "
+          + ", ".join(f"{k}={v}" for k, v in sorted(counts.items())))
+    for name in report["regressions"]:
+        row = report["metrics"][name]
+        print(f"  REGRESSION {name}: fresh={row['fresh']:g} outside "
+              f"[{row['lo']:g}, {row['hi']:g}] (median={row['median']:g}, "
+              f"direction={row['direction']})")
+    if report["regressions"] and not args.report_only:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import threading
 import time
 
@@ -66,7 +67,7 @@ class SpanTracer:
         tid = threading.get_ident()
         begin = {
             "name": name, "ph": "B", "ts": self._now_us(),
-            "pid": 0, "tid": tid,
+            "pid": os.getpid(), "tid": tid,
         }
         if args:
             begin["args"] = {k: str(v) for k, v in args.items()}
@@ -82,7 +83,7 @@ class SpanTracer:
             end_ts = self._now_us()
             with self._lock:
                 self._append({"name": name, "ph": "E", "ts": end_ts,
-                              "pid": 0, "tid": tid})
+                              "pid": os.getpid(), "tid": tid})
             ms = (end_ts - begin["ts"]) / 1e3
             self._hist.observe(ms, name=name)
             # span closes ride in the flight-recorder ring, so a postmortem

@@ -410,12 +410,28 @@ def _main(argv=None) -> int:
                         help="extra seeded-random schedules")
     parser.add_argument("--report", default="",
                         help="write the JSON report here")
+    parser.add_argument("--cluster-snapshot", default="",
+                        help="write this process's cluster-obs snapshot "
+                        "(registry + trace, identity-stamped) here so an "
+                        "aggregator can merge the chaos run into the fleet "
+                        "view offline (docs/OBSERVABILITY.md § Cluster)")
+    parser.add_argument("--push", default="",
+                        help="push the snapshot to a running aggregator at "
+                        "this host:port over the comm/ ObsPlane instead of "
+                        "(or in addition to) writing a file")
     args = parser.parse_args(argv)
 
     # force the virtual-8 CPU mesh BEFORE jax initializes a backend
     from dsml_tpu.utils.platform import configure_platform
 
     configure_platform("cpu", 8)
+
+    want_obs = bool(args.cluster_snapshot or args.push)
+    if want_obs:
+        # the snapshot is only worth merging if the run recorded itself
+        from dsml_tpu import obs as _obs
+
+        _obs.enable(forensics=False)
 
     env_schedule = config_from_env()
     if env_schedule is not None:
@@ -429,6 +445,17 @@ def _main(argv=None) -> int:
     if args.report:
         with open(args.report, "w") as f:
             f.write(line + "\n")
+    if want_obs:
+        from dsml_tpu.obs import cluster as _cluster
+
+        if args.cluster_snapshot:
+            with open(args.cluster_snapshot, "w") as f:
+                json.dump(_cluster.snapshot(role="chaos"), f)
+        if args.push:
+            try:
+                _cluster.push_snapshot(args.push, role="chaos")
+            except Exception as e:  # noqa: BLE001 — obs must not fail chaos
+                log.warning("cluster push to %s failed: %r", args.push, e)
     for v in violations:
         log.error("chaos invariant violated: %s", v)
     return 1 if violations else 0

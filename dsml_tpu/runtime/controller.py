@@ -741,7 +741,13 @@ class DecodeFleet:
     def _spawn(self, reason: str) -> int:
         rid = self._next_replica
         self._next_replica += 1
-        self._replicas[rid] = self._make()
+        replica = self._replicas[rid] = self._make()
+        # stamp the replica id into the batcher's serving metrics
+        # (admissions / occupancy / queue depth / tokens / sheds) so the
+        # cluster aggregator sees per-replica series, not one blended
+        # stream; fleet ids are never reused, so a respawn is a NEW series
+        if hasattr(replica, "obs_replica"):
+            replica.obs_replica = str(rid)
         self._idle_ticks[rid] = 0
         self._note_scale("up", rid, reason)
         return rid

@@ -249,6 +249,11 @@ class ContinuousBatcher:
         # unbounded behavior.
         self.max_queue = int(max_queue)
         self._obs = get_registry()  # no-op unless observability is enabled
+        # serving metrics are labeled per replica so a DecodeFleet's N
+        # batchers produce N distinguishable series for the cluster
+        # aggregator instead of one blended stream; a standalone batcher
+        # is replica "0". DecodeFleet restamps this at spawn time.
+        self.obs_replica = "0"
         self._queue: deque[Request] = deque()
         self._live: dict[int, Request] = {}  # queued or in a slot
         self._done: dict[int, Request] = {}  # retired, awaiting collect()
@@ -626,7 +631,8 @@ class ContinuousBatcher:
             self._obs.counter(
                 "serving_shed_total",
                 "requests rejected at submit by the queue cap",
-            ).inc()
+                labels=("replica",),
+            ).inc(replica=self.obs_replica)
             raise QueueFull(
                 f"admission queue at its cap ({self.max_queue} waiting); "
                 "request shed — retry on another replica or back off"
@@ -752,7 +758,8 @@ class ContinuousBatcher:
             admission_ms = (req.first_token_at - req.submitted_at) * 1e3
             self._obs.histogram(
                 "serving_admission_ms", "submit→first-token latency",
-            ).observe(admission_ms)
+                labels=("replica",),
+            ).observe(admission_ms, replica=self.obs_replica)
             from dsml_tpu.obs import flight_recorder
 
             flight_recorder.record(
@@ -953,14 +960,18 @@ class ContinuousBatcher:
             # "should this deployment raise n_slots"
             self._obs.histogram(
                 "serving_slot_occupancy", "active slots / n_slots per tick",
+                labels=("replica",),
                 buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
-            ).observe(self.n_active / self.n_slots)
+            ).observe(self.n_active / self.n_slots, replica=self.obs_replica)
             self._obs.gauge(
                 "serving_queue_depth", "requests waiting for a slot",
-            ).set(self.n_queued)
+                labels=("replica",),
+            ).set(self.n_queued, replica=self.obs_replica)
             self._obs.counter(
                 "serving_tokens_total", "tokens emitted",
-            ).inc(sum(len(t) for t in emitted.values()))
+                labels=("replica",),
+            ).inc(sum(len(t) for t in emitted.values()),
+                  replica=self.obs_replica)
         return emitted
 
     def _step_inner(self) -> dict[int, list]:

@@ -33,6 +33,9 @@ SECTIONS = [
     ("checkpoint", 600),  # save/restore + async-stall row (cheap, one compile)
     ("forensics", 600),   # sentinel/hangwatch overhead vs a REAL chip step
     #                       + NaN detection latency (cheap, one compile)
+    ("cluster", 600),     # aggregation-plane overhead vs a REAL chip step,
+    #                       merge/scrape/stitch micro-rows, regress gate
+    #                       self-check + collective_profile.json
     ("gpt2_decode", 1200),  # plain + wq8 + kv8 + kv4 variants, 2 compiles each
     ("allreduce", 600),   # incl. the e2e wire-path row (VERDICT r3 item 7)
     ("gpt2_seq8k", 900),
@@ -119,6 +122,26 @@ def captured_sections() -> set:
         return set()
 
 
+def _regress_report() -> None:
+    """Once every section is captured, gate the fresh evidence against the
+    committed BENCH history in REPORT-ONLY mode (the watcher's job is
+    capture, not judgment) and leave the report + calibrated collective
+    profile next to the evidence file."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "dsml_tpu.obs.regress",
+             "--fresh", EVIDENCE, "--history", "BENCH_r*.json",
+             "--report-only",
+             "--report", os.path.join(REPO, "regress_report.json"),
+             "--profile", os.path.join(REPO, "collective_profile.json")],
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+        )
+        log(f"regress report-only: rc={proc.returncode} — "
+            f"{proc.stdout.strip().splitlines()[0] if proc.stdout.strip() else ''}")
+    except Exception as e:  # the capture run must not fail on the gate
+        log(f"regress report failed: {e!r}")
+
+
 def main() -> int:
     poll_s = float(os.environ.get("TPU_WATCH_POLL_S", 600))
     skipped: set = set()  # deterministic failures — never retried
@@ -127,6 +150,7 @@ def main() -> int:
         todo = [(n, t) for n, t in SECTIONS if n not in done]
         if not todo:
             log("all sections captured — done")
+            _regress_report()
             return 0
         if not probe_alive():
             log(f"probe dead; {len(todo)} sections pending; sleeping {poll_s:.0f}s")

@@ -150,6 +150,48 @@ def test_forensics_section_schema():
             "trace.json"} <= set(rows["forensics_bundle_files"])
 
 
+def test_cluster_section_schema(tmp_path, monkeypatch):
+    """The BENCH `cluster` section's contract (ISSUE 7 acceptance): the
+    aggregation plane's DISABLED per-step overhead stays under the 1% bar,
+    the merge/scrape/stitch micro-rows are present and sane, and the
+    regress gate self-check against the committed history exits 0 with a
+    calibrated collective profile written."""
+    sys.path.insert(0, REPO)
+    import shutil
+
+    import bench
+
+    # run in a scratch cwd so the profile artifact doesn't land in the repo
+    for name in ("BENCH_r01.json", "BENCH_r02.json", "BENCH_r03.json",
+                 "BENCH_r04.json", "BENCH_r05.json"):
+        shutil.copy(os.path.join(REPO, name), tmp_path / name)
+    monkeypatch.chdir(tmp_path)
+    rows = bench.bench_cluster()
+
+    # (a) the acceptance bar: aggregation disabled-overhead < 1% per step
+    assert rows["cluster_disabled_overhead_pct"] < 1.0
+    assert rows["cluster_disabled_instrument_ns"] > 0
+    assert rows["cluster_step_wall_ms"] > 0
+
+    # (b) live hammering actually happened and was measured
+    assert rows["cluster_scrape_hammer_count"] > 0
+    assert rows["cluster_scrape_overhead_pct"] >= 0.0
+
+    # (c) plane micro-costs
+    assert rows["cluster_merge_ms"] > 0
+    assert rows["cluster_scrape_roundtrip_ms"] > 0
+    assert rows["cluster_stitch_events"] > 0
+
+    # (d) the committed history gates itself clean, and the profile JSON
+    # for the cost-model planner was written with derived constants
+    assert rows["cluster_regress_selfcheck_rc"] == 0
+    assert rows["cluster_profile_constants"] > 0
+    assert rows["cluster_profile_ring_ms_per_mb"] > 0
+    with open(tmp_path / "collective_profile.json") as f:
+        prof = json.load(f)
+    assert prof["schema"] == "dsml.obs.collective_profile/1"
+
+
 @pytest.mark.slow
 def test_cpu_fallback_emits_under_hung_probe():
     """The capped-preflight path: probe hangs, preflight gives up inside its

@@ -1,0 +1,449 @@
+"""Cluster observability plane: merge math, clock alignment, the
+3-process scrape→merge round-trip, and the wire-cluster acceptance run.
+
+The merge-math pins compare against numpy on the same samples (exact-sum
+counters, bucket-wise histogram merge) — the aggregator must be an
+arithmetic identity over the per-process registries, not an estimate.
+The acceptance test is ISSUE 7's: coordinator + 2 device-server
+SUBPROCESSES → one merged Prometheus exposition with host/role labels
+and one chrome-loadable stitched trace where the coordinator's wire-op
+span brackets the device servers' device-side spans on the aligned
+timeline.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dsml_tpu import obs
+from dsml_tpu.obs.cluster import (
+    ClockSync,
+    ClusterAggregator,
+    estimate_quantile,
+    merge_snapshots,
+    run_cluster_demo,
+    snapshot,
+    stitch_traces,
+)
+from dsml_tpu.obs.registry import Registry
+
+BOUNDS = (1.0, 2.0, 4.0, 8.0)
+
+
+def _proc_snapshot(host, pid, role, build, wall_s=1000.0, mono_us=0.0,
+                   trace=None):
+    """A snapshot as ``snapshot()`` would emit it for a private registry,
+    with identity overridden so one test process can fake a fleet."""
+    reg = Registry(enabled=True)
+    build(reg)
+    snap = {
+        "schema": "dsml.obs.cluster/1", "host": host, "pid": pid,
+        "role": role, "wall_s": wall_s, "mono_us": mono_us,
+        "enabled": True, "metrics": reg.collect(),
+    }
+    if trace is not None:
+        snap["trace"] = trace
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# merge math, pinned against numpy
+# ---------------------------------------------------------------------------
+
+
+def test_counter_merge_is_exact_sum():
+    vals = [3.0, 41.5, 0.25]
+    snaps = [
+        _proc_snapshot(f"h{i}", 100 + i, "worker", lambda reg, v=v: reg.counter(
+            "events_total", labels=("kind",)).inc(v, kind="x"))
+        for i, v in enumerate(vals)
+    ]
+    view = merge_snapshots(snaps)
+    fleet = [r for r in view.collect()
+             if r["name"] == "events_total:fleet"]
+    assert len(fleet) == 1
+    assert fleet[0]["value"] == sum(vals)  # exact, not approx
+    # per-process layer keeps every contribution, identity-labeled
+    per_proc = [r for r in view.collect() if r["name"] == "events_total"]
+    assert {r["labels"]["host"] for r in per_proc} == {"h0", "h1", "h2"}
+    assert all(r["labels"]["role"] == "worker" for r in per_proc)
+
+
+def test_histogram_merge_bucketwise_pinned_against_numpy():
+    rng = np.random.default_rng(0)
+    samples = [rng.uniform(0, 10, 40), rng.uniform(0, 10, 25),
+               rng.uniform(0, 10, 33)]
+    snaps = []
+    for i, arr in enumerate(samples):
+        def build(reg, arr=arr):
+            h = reg.histogram("lat_ms", buckets=BOUNDS)
+            for v in arr:
+                h.observe(float(v))
+        snaps.append(_proc_snapshot("h", 200 + i, "worker", build))
+    view = merge_snapshots(snaps)
+    fleet = next(r for r in view.collect() if r["name"] == "lat_ms:fleet")
+    pooled = np.concatenate(samples)
+    # cumulative bucket counts must equal numpy's on the pooled samples
+    for b in BOUNDS:
+        assert fleet["buckets"][str(b)] == int(np.sum(pooled <= b)), b
+    assert fleet["buckets"]["+Inf"] == len(pooled)
+    assert fleet["count"] == len(pooled)
+    assert fleet["sum"] == pytest.approx(float(pooled.sum()), rel=1e-9)
+
+
+def test_histogram_bound_mismatch_keeps_per_process_and_notes():
+    a = _proc_snapshot("h", 1, "w", lambda reg: reg.histogram(
+        "lat_ms", buckets=BOUNDS).observe(1.0))
+    b = _proc_snapshot("h", 2, "w", lambda reg: reg.histogram(
+        "lat_ms", buckets=(5.0, 50.0)).observe(1.0))
+    view = merge_snapshots([a, b])
+    names = [r["name"] for r in view.collect()]
+    assert names.count("lat_ms") == 2          # both per-process series live
+    assert "lat_ms:fleet" not in names         # no lying fleet aggregate
+    assert any("bucket bounds differ" in n for n in view.notes)
+
+
+def test_estimate_quantile_linear_interpolation_pinned():
+    # 10 samples <=1, 10 in (1,2], none above: cumulative {1:10, 2:20}
+    cum = {"1.0": 10, "2.0": 20, "+Inf": 20}
+    # p50 rank=10 lands exactly at bound 1.0's cumulative → 1.0
+    assert estimate_quantile(("1.0", "2.0"), cum, 0.5) == pytest.approx(1.0)
+    # p75 rank=15: 5 of the 10 samples inside (1,2] → 1.5
+    assert estimate_quantile(("1.0", "2.0"), cum, 0.75) == pytest.approx(1.5)
+    assert estimate_quantile(("1.0", "2.0"), {"1.0": 0, "2.0": 0, "+Inf": 0},
+                             0.5) is None
+
+
+def test_gauges_are_not_fleet_aggregated():
+    snaps = [
+        _proc_snapshot("h", i, "w", lambda reg, i=i: reg.gauge(
+            "queue_depth").set(float(i)))
+        for i in (1, 2)
+    ]
+    view = merge_snapshots(snaps)
+    names = [r["name"] for r in view.collect()]
+    assert "queue_depth:fleet" not in names  # sum-vs-mean is a per-metric call
+    rep = view.report()
+    assert rep["gauges"]["queue_depth"] == {
+        "min": 1.0, "mean": 1.5, "max": 2.0, "n": 2}
+
+
+def test_fleet_goodput_means_per_process_gauges():
+    snaps = [
+        _proc_snapshot("h", i, "trainer", lambda reg, g=g: reg.gauge(
+            "train_goodput").set(g))
+        for i, g in enumerate((0.9, 0.5))
+    ]
+    view = merge_snapshots(snaps)
+    assert view.fleet_goodput() == pytest.approx(0.7)
+    rec = next(r for r in view.collect() if r["name"] == "fleet_goodput")
+    assert rec["value"] == pytest.approx(0.7)
+
+
+def test_straggler_ranking_flags_slow_process():
+    def fast(reg):
+        h = reg.histogram("span_ms", labels=("name",))
+        for _ in range(20):
+            h.observe(1.0, name="step")
+
+    def slow(reg):
+        h = reg.histogram("span_ms", labels=("name",))
+        for _ in range(20):
+            h.observe(400.0, name="step")
+
+    snaps = [_proc_snapshot("a", 1, "trainer", fast),
+             _proc_snapshot("b", 2, "trainer", fast),
+             _proc_snapshot("c", 3, "trainer", slow)]
+    rows = merge_snapshots(snaps).straggler_ranking(
+        "span_ms", where={"name": "step"})
+    assert rows[0]["host"] == "c" and rows[0]["straggler"] is True
+    assert all(not r["straggler"] for r in rows[1:])
+
+
+def test_prometheus_exposition_one_text_with_identity_labels():
+    snaps = [
+        _proc_snapshot("hostA", 11, "coordinator", lambda reg: reg.counter(
+            "ops_total").inc(2.0)),
+        _proc_snapshot("hostB", 22, "device_server", lambda reg: reg.counter(
+            "ops_total").inc(3.0)),
+    ]
+    text = merge_snapshots(snaps).to_prometheus_text()
+    assert 'ops_total{host="hostA",pid="11",role="coordinator"} 2' in text
+    assert 'ops_total{host="hostB",pid="22",role="device_server"} 3' in text
+    assert 'ops_total:fleet 5' in text
+    # every non-comment line is exposition-format shaped
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        assert re.match(r'^[A-Za-z_:][A-Za-z0-9_:]*(\{[^}]*\})? \S+$', line), line
+
+
+def test_snapshot_schema_and_identity():
+    reg = Registry(enabled=True)
+    reg.counter("c").inc()
+    snap = snapshot(role="tester", registry=reg)
+    assert snap["schema"] == "dsml.obs.cluster/1"
+    assert snap["pid"] == os.getpid()
+    assert snap["role"] == "tester"
+    assert {"host", "wall_s", "mono_us", "metrics", "trace"} <= set(snap)
+    json.dumps(snap)  # wire-serializable as-is
+    with pytest.raises(ValueError, match="schema"):
+        merge_snapshots([{"schema": "bogus"}])
+
+
+# ---------------------------------------------------------------------------
+# clock alignment + trace stitching
+# ---------------------------------------------------------------------------
+
+
+def _trace(events):
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _span_events(name, t0, t1, pid=1, tid=1):
+    return [
+        {"name": name, "ph": "B", "ts": float(t0), "pid": pid, "tid": tid},
+        {"name": name, "ph": "E", "ts": float(t1), "pid": pid, "tid": tid},
+    ]
+
+
+def test_handshake_offset_is_rtt_midpoint():
+    # aggregator clock read t0=100, t1=140; worker answered mono=5000 at
+    # the midpoint 120 → offset 120-5000
+    sync = ClockSync.from_handshake(100.0, 140.0, 5000.0)
+    assert sync.offset_us == pytest.approx(120.0 - 5000.0)
+    assert sync.rtt_us == pytest.approx(40.0)
+    assert sync.method == "handshake"
+
+
+def test_wall_fallback_offset():
+    ref_wall, ref_mono = 1000.0, 50_000.0
+    snap = {"wall_s": 1000.5, "mono_us": 10_000.0}  # 0.5s ahead in wall
+    sync = ClockSync.from_wall(snap, ref_wall, ref_mono)
+    # a worker event at its mono 10_000 happened at ref wall 1000.5 →
+    # ref mono 50_000 + 500_000
+    assert 10_000.0 + sync.offset_us == pytest.approx(550_000.0)
+    assert sync.method == "wall"
+
+
+def test_stitch_aligns_device_span_inside_wire_span():
+    """The acceptance geometry, synthetically: the coordinator's wire_op
+    ran [2000, 6000]µs on its clock; the device's device_memcpy ran
+    [1000, 2000]µs on ITS clock, which the handshake places 2500µs later
+    — after alignment the device interval sits inside the wire interval."""
+    coord = _proc_snapshot(
+        "c", 1, "coordinator", lambda reg: None,
+        trace=_trace(_span_events("wire_op", 2000, 6000)))
+    dev = _proc_snapshot(
+        "d", 2, "device_server", lambda reg: None,
+        trace=_trace(_span_events("device_memcpy", 1000, 2000)))
+    stitched = stitch_traces(
+        [coord, dev],
+        syncs={0: ClockSync(0.0, 0.0, "identity"),
+               1: ClockSync(2500.0, 10.0, "handshake")},
+    )
+    ev = stitched["traceEvents"]
+    by = {(e["name"], e["ph"]): e["ts"] for e in ev if e["ph"] != "M"}
+    wire_b, wire_e = by[("wire_op", "B")], by[("wire_op", "E")]
+    dev_b, dev_e = by[("device_memcpy", "B")], by[("device_memcpy", "E")]
+    assert wire_b <= dev_b <= dev_e <= wire_e
+    # re-zeroed: the earliest timed event starts at 0
+    assert min(wire_b, dev_b) == pytest.approx(0.0)
+    # one lane per process, named via metadata events
+    names = {e["args"]["name"] for e in ev if e["name"] == "process_name"}
+    assert names == {"coordinator c:1", "device_server d:2"}
+    # distinct pids even though both processes could collide
+    assert len({e["pid"] for e in ev if e["ph"] != "M"}) == 2
+
+
+def test_stitch_remaps_colliding_pids_and_sorts_by_ts():
+    a = _proc_snapshot("hA", 7, "w", lambda reg: None,
+                       trace=_trace(_span_events("x", 10, 20, pid=7)))
+    b = _proc_snapshot("hB", 7, "w", lambda reg: None,
+                       trace=_trace(_span_events("y", 0, 5, pid=7)),
+                       wall_s=1000.0, mono_us=0.0)
+    stitched = stitch_traces([a, b])
+    timed = [e for e in stitched["traceEvents"] if e["ph"] != "M"]
+    assert len({e["pid"] for e in timed}) == 2
+    assert [e["ts"] for e in timed] == sorted(e["ts"] for e in timed)
+    json.dumps(stitched)  # chrome-loadable (JSON-serializable)
+
+
+# ---------------------------------------------------------------------------
+# 3-process scrape→merge round-trip (lightweight workers, HTTP path)
+# ---------------------------------------------------------------------------
+
+_WORKER_SRC = """
+import sys, time
+from dsml_tpu import obs
+obs.enable(forensics=False)
+reg = obs.get_registry()
+reg.counter("roundtrip_total").inc(float(sys.argv[1]))
+h = reg.histogram("roundtrip_ms", buckets=(1.0, 10.0, 100.0))
+for v in (0.5, 5.0, 50.0):
+    h.observe(v)
+with obs.span("worker_phase"):
+    time.sleep(0.01)
+srv = obs.start_metrics_server(port=0)
+print(srv.port, flush=True)
+sys.stdin.read()
+"""
+
+
+def test_three_process_scrape_merge_roundtrip(tmp_path):
+    """Two worker subprocesses + this process: scrape each over HTTP with
+    the clock handshake, merge, and check the fleet arithmetic survived
+    the wire exactly."""
+    env = {**os.environ, "DSML_OBS_ROLE": "worker", "JAX_PLATFORMS": "cpu"}
+    procs = []
+    try:
+        for v in (3, 4):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", _WORKER_SRC, str(v)],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env,
+                text=True, cwd="/root/repo",
+            ))
+        ports = [int(p.stdout.readline()) for p in procs]
+        agg = ClusterAggregator()
+        # this process contributes its own snapshot (private registry so
+        # the suite's global state stays untouched)
+        reg = Registry(enabled=True)
+        reg.counter("roundtrip_total").inc(5.0)
+        agg.add({**snapshot(role="aggregator", registry=reg)},
+                ClockSync(0.0, 0.0, "identity"))
+        for port in ports:
+            snap = agg.scrape(f"http://127.0.0.1:{port}")
+            assert snap["role"] == "worker"
+        view = agg.merged()
+        fleet = next(r for r in view.collect()
+                     if r["name"] == "roundtrip_total:fleet")
+        assert fleet["value"] == 3.0 + 4.0 + 5.0
+        hist = next(r for r in view.collect()
+                    if r["name"] == "roundtrip_ms:fleet")
+        assert hist["count"] == 6  # 3 samples × 2 workers
+        assert hist["buckets"]["1.0"] == 2
+        rep = agg.report()
+        assert len(rep["processes"]) == 3
+        # scraped processes got handshake syncs with sane RTTs
+        methods = [s["method"] for s in rep["clock_sync"].values()]
+        assert methods.count("handshake") == 2
+        paths = agg.write_artifacts(str(tmp_path / "out"))
+        assert os.path.exists(paths["prometheus"])
+        with open(paths["trace"]) as f:
+            trace = json.load(f)
+        assert any(e.get("name") == "worker_phase"
+                   for e in trace["traceEvents"])
+    finally:
+        for p in procs:
+            try:
+                p.stdin.close()
+            except OSError:
+                pass
+            p.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# gRPC obs plane: pull + push over comm/ plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_grpc_pull_from_device_server(devices8):
+    from dsml_tpu.comm.device_server import serve_local_devices
+
+    was = obs.enabled()
+    obs.enable(forensics=False)
+    handles = serve_local_devices(1, base_device_id=42)
+    try:
+        handles[0].runtime.memcpy_h2d(0x1000, b"\x00" * 64)
+        agg = ClusterAggregator()
+        snap = agg.pull(handles[0].address)
+        assert snap["role"] == "device_server"
+        assert snap["pid"] == os.getpid()
+        rep = agg.report()
+        sync = next(iter(rep["clock_sync"].values()))
+        assert sync["method"] == "handshake"
+        assert sync["rtt_us"] is not None and sync["rtt_us"] >= 0
+    finally:
+        for h in handles:
+            h.stop()
+        if not was:
+            obs.disable()
+
+
+def test_grpc_push_to_aggregator():
+    from dsml_tpu.obs.cluster import push_snapshot, serve_aggregator
+
+    agg = ClusterAggregator()
+    handle = serve_aggregator(agg)
+    try:
+        reg = Registry(enabled=True)
+        reg.counter("pushed_total").inc(7.0)
+        ack = push_snapshot(handle.address, role="pusher", registry=reg)
+        assert ack["ok"] is True
+        view = agg.merged()
+        rec = next(r for r in view.collect()
+                   if r["name"] == "pushed_total:fleet")
+        assert rec["value"] == 7.0
+        assert view.processes[0]["role"] == "pusher"
+    finally:
+        handle.stop()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance run: coordinator + 2 device-server subprocesses
+# ---------------------------------------------------------------------------
+
+
+def test_wire_cluster_merged_exposition_and_stitched_trace(tmp_path):
+    """ISSUE 7 acceptance: a 3-process virtual cluster yields ONE merged
+    Prometheus exposition with host/role labels and ONE chrome-loadable
+    stitched trace where a wire-op span and a device-side span share an
+    aligned timeline (device execution inside the coordinator's wire op,
+    within the handshake's RTT error bound)."""
+    was = obs.enabled()
+    out = str(tmp_path / "cluster")
+    try:
+        report = run_cluster_demo(out, n_devices=2)
+    finally:
+        if not was:
+            obs.disable()
+    assert report["n_processes"] == 3
+    roles = [p["role"] for p in report["processes"]]
+    assert roles.count("device_server") == 2 and "coordinator" in roles
+
+    with open(report["artifacts"]["prometheus"]) as f:
+        text = f.read()
+    assert 'role="coordinator"' in text and 'role="device_server"' in text
+    # the coordinator's wire-op latency made it into the merged exposition
+    assert "collective_latency_ms" in text
+
+    with open(report["artifacts"]["trace"]) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    spans = {}
+    for e in events:
+        if e["ph"] in ("B", "E"):
+            spans.setdefault((e["name"], e["pid"]), {})[e["ph"]] = e["ts"]
+    wire = [v for (n, _), v in spans.items() if n == "wire_op"]
+    dev = [v for (n, _), v in spans.items() if n == "device_memcpy"]
+    assert wire and dev, "both lanes must carry spans"
+    wb, we = wire[0]["B"], wire[0]["E"]
+    # the handshake bounds the alignment error by rtt/2; allow a loopback-
+    # generous 5 ms slack on each side
+    slack_us = 5000.0
+    aligned = [v for v in dev
+               if v["B"] >= wb - slack_us and v["E"] <= we + slack_us]
+    assert aligned, (
+        f"no device-side span inside the wire op: wire=[{wb}, {we}], "
+        f"device intervals={[(v['B'], v['E']) for v in dev]}"
+    )
+    # distinct lanes: coordinator pid != device pids
+    pids = {pid for (n, pid) in spans if n == "wire_op"} | \
+        {pid for (n, pid) in spans if n == "device_memcpy"}
+    assert len(pids) >= 2

@@ -314,3 +314,109 @@ def test_decode_fleet_queue_depth_autoscale():
     downs = [e for e in fleet.scale_events
              if e["direction"] == "down" and e["reason"] == "idle"]
     assert len(downs) == 2
+
+
+def test_live_coordinator_failure_feed_drives_recovery(devices8, tmp_path):
+    """ISSUE 7 satellite / ROADMAP item: the controller's failure_feed
+    wired from a LIVE CoordinatorRuntime.add_failure_listener in the
+    wire-compat cluster (real gRPC device servers, real health probes) —
+    no injected feeds. Killing a device server's socket makes the health
+    loop's death verdict arrive as the controller's DeviceLost, and the
+    run shrinks and completes."""
+    from dsml_tpu.comm.coordinator import CoordinatorConfig, CoordinatorRuntime
+    from dsml_tpu.comm.device_server import serve_local_devices
+    from dsml_tpu.runtime.controller import StaticFleet
+
+    # device ids == jax device ids, so coordinator verdicts name devices
+    # the controller's mesh actually contains
+    handles = serve_local_devices(2, base_device_id=0, mem_size=0x4000)
+    rt = CoordinatorRuntime(CoordinatorConfig(
+        health_interval_s=0.1, probe_timeout_s=0.5,
+        dial_retries=2, dial_backoff_s=0.05,
+    ))
+    model, cfg = _model()
+    provider = _batches(cfg, 12)
+    spec = MeshSpec(dp=2)
+    try:
+        rt.comm_init(2, [h.address for h in handles])
+        feed = rt.failure_feed()
+        controller = ElasticController(
+            model, optax.adam(1e-2), provider,
+            checkpoint_dir=str(tmp_path / "ck"),
+            fleet=StaticFleet(devices8[:2]),
+            mesh=build_mesh(spec, devices8[:2]), spec=spec,
+            config=ControllerConfig(checkpoint_every=4, detect_every=10_000),
+            global_batch=8, seed=0,
+            failure_feed=feed,
+        )
+
+        import time as _time
+
+        from dsml_tpu.comm.proto import gpu_sim_pb2 as _pb
+
+        killed = {"done": False}
+
+        def on_step(step):
+            if step == 4 and not killed["done"]:
+                killed["done"] = True
+                handles[1].stop()
+                # wait for the health loop to probe, fail the comm, and
+                # push its verdict; the NEXT step's detection pass drains
+                # the feed into a DeviceLost
+                deadline = _time.time() + 15.0
+                while _time.time() < deadline:
+                    if rt.comms[1].status == _pb.FAILED:
+                        break
+                    _time.sleep(0.05)
+                else:
+                    raise AssertionError("health loop never failed the comm")
+
+        with controller:
+            report = controller.run(12, on_step=on_step)
+    finally:
+        rt.stop()
+        for h in handles:
+            h.stop()
+    assert report["steps_completed"] == 12
+    assert report["n_recoveries"] >= 1
+    kinds = [r["kind"] for r in report["recoveries"]]
+    assert any(k in ("reconfigure", "checkpoint_fallback") for k in kinds)
+    shrink = report["recoveries"][0]
+    assert shrink["to_width"] == 1          # survivor-only mesh
+    # the verdict named the REAL device id the health loop saw die
+    assert [getattr(d, "id", d) for d in shrink["lost_devices"]] == [1]
+
+
+def test_decode_fleet_metrics_are_labeled_per_replica():
+    """ISSUE 7 satellite: DecodeFleet serving metrics carry per-replica
+    labels so the aggregator sees N series, not one blended stream."""
+    from dsml_tpu import obs
+    from dsml_tpu.serving import ContinuousBatcher
+
+    model, cfg = _model()
+    params = model.init(0)
+    was = obs.enabled()
+    obs.enable(forensics=False)
+    try:
+        reg = obs.get_registry()
+        tokens = reg.counter("serving_tokens_total", "tokens emitted",
+                             labels=("replica",))
+        before = {r: tokens.value(replica=r) for r in ("0", "1")}
+        fleet = DecodeFleet(
+            lambda: ContinuousBatcher(model, params, n_slots=2, max_queue=8),
+            min_replicas=2, max_replicas=2, scale_down_idle_ticks=10_000,
+        )
+        assert [b.obs_replica for b in fleet._replicas.values()] == ["0", "1"]
+        for p in _prompts(cfg, n=6):
+            fleet.submit(p, 4)
+        fleet.run()
+        emitted = {r: tokens.value(replica=r) - before[r] for r in ("0", "1")}
+        # both replicas worked AND their series are distinguishable
+        assert emitted["0"] > 0 and emitted["1"] > 0
+        assert emitted["0"] + emitted["1"] == 6 * 4
+        depth = reg.gauge("serving_queue_depth", labels=("replica",))
+        assert depth.value(replica="0") is not None
+        assert depth.value(replica="1") is not None
+    finally:
+        if not was:
+            obs.disable()

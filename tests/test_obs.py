@@ -637,12 +637,18 @@ def test_serving_admission_and_occupancy_metrics():
         for _ in range(3):
             srv.submit(rng.integers(0, 64, (8,)).astype(np.int32), 4)
         srv.run()
-        assert reg.histogram("serving_admission_ms").summary()["count"] == 3
+        # serving metrics carry a replica label (a standalone batcher is
+        # replica "0"; DecodeFleet restamps per spawn)
         assert reg.histogram(
-            "serving_slot_occupancy",
+            "serving_admission_ms", labels=("replica",)
+        ).summary(replica="0")["count"] == 3
+        assert reg.histogram(
+            "serving_slot_occupancy", labels=("replica",),
             buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
-        ).summary()["count"] >= 1
-        assert reg.counter("serving_tokens_total").value() == 3 * 4
+        ).summary(replica="0")["count"] >= 1
+        assert reg.counter(
+            "serving_tokens_total", labels=("replica",)
+        ).value(replica="0") == 3 * 4
     finally:
         if not was:
             reg.disable()

@@ -841,13 +841,15 @@ def test_queue_cap_sheds_explicitly():
     try:
         reg = obs.get_registry()
         shed = reg.counter(
-            "serving_shed_total", "requests rejected at submit by the queue cap"
+            "serving_shed_total",
+            "requests rejected at submit by the queue cap",
+            labels=("replica",),
         )
-        before = shed.value()
+        before = shed.value(replica="0")
         rids = [srv.submit(p, 3) for p in prompts[:2]]  # queue holds 2
         with pytest.raises(QueueFull, match="cap"):
             srv.submit(prompts[2], 3)
-        assert shed.value() - before == 1
+        assert shed.value(replica="0") - before == 1
         assert srv.n_queued == 2  # the shed request left no residue
         # draining frees queue space: submit succeeds again afterwards
         out = srv.run()
