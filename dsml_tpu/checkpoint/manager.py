@@ -151,6 +151,21 @@ class CheckpointManager:
                 out.append(step)
         return sorted(out)
 
+    @staticmethod
+    def newest_common_step(per_host_steps) -> int | None:
+        """The newest step EVERY host has committed — the step a
+        COORDINATED all-hosts checkpoint fallback must restore when P2P
+        shard migration cannot deliver (docs/ELASTIC.md § Multi-host
+        recovery). A host restoring a step its peers never committed would
+        desync the fleet; the intersection is the only safe set. ``None``
+        when any host has nothing (or the intersection is empty): the
+        outage predates the first fleet-wide commit."""
+        sets = [set(int(s) for s in steps) for steps in per_host_steps]
+        if not sets:
+            return None
+        common = set.intersection(*sets)
+        return max(common) if common else None
+
     def latest_step(self, where=None) -> int | None:
         """Newest committed step; with ``where`` (a predicate over the
         step's manifest ``meta`` dict), the newest step whose meta

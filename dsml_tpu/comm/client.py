@@ -44,8 +44,11 @@ def call_with_retries(op: str, fn, retries: int | None = None,
     """Run ``fn()`` with bounded exponential backoff + jitter on transient
     gRPC codes (:data:`TRANSIENT_CODES`); anything else raises immediately.
 
-    The control-plane RPCs this wraps (CommInit / GetCommStatus /
-    membership refresh) are exactly the calls a preemption storm flakes:
+    The RPCs this wraps — control plane (CommInit / GetCommStatus /
+    membership refresh) AND the data-plane arm ops (BeginSend /
+    BeginReceive / GetStreamStatus, plus the migration plane's
+    PlanPieces / BeginMigration) — are exactly the calls a preemption
+    storm flakes:
     failing a whole training job on one UNAVAILABLE while the coordinator
     restarts is the reference's brittleness, not a contract. Retries are
     BOUNDED (default 4, ``DSML_COMM_RETRIES``) and jittered (0.5–1.5× the
@@ -258,6 +261,52 @@ class PipelineClient:
             self.write(rank, addr, data)
         self.all_reduce_ring(nbytes, op=op, mem_addrs={r: addr for r in range(n)})
         return bytes_to_f32(self.read(0, addr, nbytes))
+
+    # ---- P2P streams (data-plane arm RPCs, retried like control-plane) ----------
+
+    def begin_send(self, rank: int, send_addr: int, num_bytes: int,
+                   dst_rank: int, timeout: float = 5.0) -> int:
+        """Arm a P2P send on ``rank``; returns the stream id. The arm RPCs
+        are the data plane's CONTROL half — a transient flake here used to
+        fail the whole transfer while CommInit-class ops retried; now all
+        three (BeginSend / BeginReceive / GetStreamStatus) ride
+        :func:`call_with_retries` with the same bounded jittered backoff."""
+        resp = call_with_retries(
+            "BeginSend",
+            lambda: self.devices[rank].BeginSend(
+                pb.BeginSendRequest(
+                    sendBuffAddr=pb.MemAddr(value=send_addr),
+                    numBytes=num_bytes,
+                    dstRank=pb.Rank(value=dst_rank),
+                ),
+                timeout=timeout,
+            ),
+        )
+        return resp.streamId.value
+
+    def begin_receive(self, rank: int, stream_id: int, recv_addr: int,
+                      num_bytes: int, src_rank: int, timeout: float = 5.0) -> None:
+        call_with_retries(
+            "BeginReceive",
+            lambda: self.devices[rank].BeginReceive(
+                pb.BeginReceiveRequest(
+                    streamId=pb.StreamId(value=stream_id),
+                    recvBuffAddr=pb.MemAddr(value=recv_addr),
+                    numBytes=num_bytes,
+                    srcRank=pb.Rank(value=src_rank),
+                ),
+                timeout=timeout,
+            ),
+        )
+
+    def stream_status(self, rank: int, stream_id: int, timeout: float = 5.0) -> int:
+        return call_with_retries(
+            "GetStreamStatus",
+            lambda: self.devices[rank].GetStreamStatus(
+                pb.GetStreamStatusRequest(streamId=pb.StreamId(value=stream_id)),
+                timeout=timeout,
+            ),
+        ).status
 
     # ---- on-device compute -----------------------------------------------------
 

@@ -244,6 +244,27 @@ class CoordinatorRuntime:
         with comm.lock:
             return comm.status, [(i.rank, i.device_id, i.address) for i in comm.devices]
 
+    def broker_migration(self, comm_id: int, local_device_id: int):
+        """Membership-table routing for cross-host shard migration
+        (``comm.migration.ShardMigrator``): resolve which member is the
+        caller (``self_rank`` — where donors push their streams) and which
+        are potential donors. Returns ``(self_rank, [(rank, address), …])``
+        over the CURRENT membership, which elastic recovery may have
+        renumbered — the same freshness contract as :meth:`comm_members`."""
+        _, members = self.comm_members(comm_id)
+        self_rank, donors = None, []
+        for rank, device_id, address in members:
+            if device_id == local_device_id:
+                self_rank = rank
+            else:
+                donors.append((rank, address))
+        if self_rank is None:
+            raise DeviceError(
+                grpc.StatusCode.NOT_FOUND,
+                f"device {local_device_id} is not a member of comm {comm_id}",
+            )
+        return self_rank, donors
+
     def comm_destroy(self, comm_id: int) -> None:
         comm = self._get_comm(comm_id)
         with self._lock:

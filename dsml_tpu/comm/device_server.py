@@ -20,7 +20,9 @@ Semantics preserved from the reference:
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from concurrent import futures
 from dataclasses import dataclass, field
 
@@ -32,7 +34,8 @@ import numpy as np
 from dsml_tpu.comm import rpc
 from dsml_tpu.comm.proto import gpu_sim_pb2 as pb
 from dsml_tpu.models.mlp import MLP
-from dsml_tpu.obs import span
+from dsml_tpu.obs import get_registry, span
+from dsml_tpu.utils.config import env_float as _env_float
 from dsml_tpu.utils.logging import get_logger
 
 log = get_logger("device")
@@ -74,6 +77,14 @@ class StreamState:
     received: int = 0
     armed: bool = False  # BeginReceive seen
     sender_done: bool = False  # StreamSend finished delivering
+    # lifecycle stamps (monotonic clock): terminal streams are TTL-evicted
+    # from the table, and an armed stream making no progress past the stall
+    # deadline is FAILED instead of staying IN_PROGRESS forever (the
+    # dropped-StreamSend hole the migration path must not fall into)
+    created_at: float = field(default_factory=time.monotonic)
+    done_at: float | None = None
+    last_progress: float = field(default_factory=time.monotonic)
+    fail_reason: str = ""
 
 
 class BufferRegistry:
@@ -189,7 +200,13 @@ class DeviceRuntime:
         self.memory = BufferRegistry(self.jax_device, min_addr, mem_size)
         self.streams: dict[int, StreamState] = {}
         self._stream_lock = threading.Lock()
-        self._next_stream = 1
+        # Stream ids are sender-namespaced (device_id << 32 | counter), but
+        # a RESTARTED sender process would reset its counter to 1 and reuse
+        # ids a long-lived receiver still holds as terminal entries — the
+        # receiver would then "complete" the new stream with stale state.
+        # A random counter origin makes cross-restart collisions
+        # vanishingly unlikely.
+        self._next_stream = int.from_bytes(os.urandom(4), "little") % (1 << 31) or 1
         self.peers: dict[int, str] = {}
         self.self_rank: int | None = None
         self._peer_stubs: dict[int, rpc._Stub] = {}
@@ -199,6 +216,7 @@ class DeviceRuntime:
         self.weights_addr = weights_addr
         self._last_input: jax.Array | None = None
         self.bound_address: str | None = None  # set by serve_device once bound
+        self.donor = None  # StateDonor, attached by serve_device
         with _LOCAL_LOCK:
             _LOCAL_DEVICES[device_id] = self
 
@@ -234,6 +252,7 @@ class DeviceRuntime:
             self.streams[stream_id] = StreamState(
                 stream_id, send_addr=send_addr, num_bytes=num_bytes, dst_rank=dst_rank
             )
+            self._update_stream_gauge_locked()
         # Push the payload to the destination in the background, as the proto
         # intends ("the actual data transfer should happen in the background
         # initiated by the devices", gpu_sim.proto) — the reference never
@@ -244,31 +263,63 @@ class DeviceRuntime:
     def begin_receive(self, stream_id: int, recv_addr: int, num_bytes: int, src_rank: int) -> None:
         self.memory.check_bounds(recv_addr, num_bytes)
         with self._stream_lock:
-            st = self.streams.setdefault(stream_id, StreamState(stream_id))
+            st = self.streams.get(stream_id)
+            if st is None or st.status != pb.IN_PROGRESS:
+                # arming a TERMINAL id means the sender recycled it (e.g. a
+                # restarted peer): this is a NEW stream, not a re-arm of the
+                # finished one — a fresh state, never stale bytes
+                st = self.streams[stream_id] = StreamState(stream_id)
             st.recv_addr = recv_addr
             st.num_bytes = num_bytes
             st.src_rank = src_rank
             st.armed = True
+            st.last_progress = time.monotonic()
             self._maybe_complete_locked(st)
+            self._update_stream_gauge_locked()
 
     def receive_chunks(self, chunk_iter) -> bool:
         """StreamSend handler: accumulate chunks; complete when the armed
         length arrives (length validation as gpu_device_server.go:165-179)."""
         stream_id = None
+        seen: set = set()
         for chunk in chunk_iter:
             with self._stream_lock:
-                st = self.streams.setdefault(chunk.streamId, StreamState(chunk.streamId))
+                st = self.streams.get(chunk.streamId)
+                if st is None or (st.status != pb.IN_PROGRESS
+                                  and chunk.streamId not in seen):
+                    # FIRST chunk of a stream whose id maps to a TERMINAL
+                    # entry: a restarted sender recycled the id (same case
+                    # begin_receive handles) — this is a NEW stream; a
+                    # stale SUCCESS entry must not swallow its payload and
+                    # report delivery that never landed. A stream that
+                    # goes terminal MID-call (harvest/stall) keeps its
+                    # entry: those chunks are the old stream's stragglers.
+                    st = self.streams[chunk.streamId] = StreamState(chunk.streamId)
+                seen.add(chunk.streamId)
                 stream_id = chunk.streamId
                 st.chunks.append(chunk.data)
                 st.received += len(chunk.data)
+                st.last_progress = time.monotonic()
         if stream_id is None:
             return False
         with self._stream_lock:
             st = self.streams[stream_id]
             st.sender_done = True
-            return self._maybe_complete_locked(st, final=True)
+            ok = self._maybe_complete_locked(st, final=True)
+        # GC on the RECEIVE path too: a receive-only server (exactly what a
+        # migration receiver is) never pushes, so _push_stream's GC call
+        # would never run for it and its terminal entries would accumulate
+        self._gc_streams()
+        return ok
 
     def _maybe_complete_locked(self, st: StreamState, final: bool = False) -> bool:
+        if st.status != pb.IN_PROGRESS:
+            # terminal is terminal: a LATE full delivery on a stream already
+            # failed (stall verdict, take_partial harvest) must not write to
+            # recv_addr — the migrator may have re-armed the same landing
+            # address for its next piece, and a stale write there would
+            # clobber it between completion and read-back
+            return st.status == pb.SUCCESS
         if not st.armed or st.recv_addr is None:
             return True  # waiting for BeginReceive; chunks stay buffered
         # a late BeginReceive must still see that the sender already finished
@@ -279,22 +330,86 @@ class DeviceRuntime:
             st.chunks = []  # payload now lives in the registry; don't retain it
             try:
                 self.memory.write(st.recv_addr, data)
-            except DeviceError:
-                st.status = pb.FAILED
+            except DeviceError as e:
+                self._finish_locked(st, pb.FAILED, f"recv write failed: {e}")
                 return False
-            st.status = pb.SUCCESS
+            self._finish_locked(st, pb.SUCCESS)
             return True
         if final or st.received > st.num_bytes:
-            st.status = pb.FAILED
+            self._finish_locked(
+                st, pb.FAILED,
+                f"length mismatch: received {st.received} of {st.num_bytes}",
+            )
             return False
         return True
 
+    def _finish_locked(self, st: StreamState, status: int, reason: str = "") -> None:
+        """Terminal transition (idempotent): stamp ``done_at`` so the TTL GC
+        can reap the entry, count failures, refresh the active gauge."""
+        if st.status != pb.IN_PROGRESS:
+            return  # already terminal — a late writer must not double-count
+        st.status = status
+        st.done_at = time.monotonic()
+        if status == pb.FAILED:
+            st.fail_reason = reason
+            reg = get_registry()
+            if reg.enabled:
+                reg.counter(
+                    "comm_stream_failures_total",
+                    "P2P streams that ended FAILED", labels=("device",),
+                ).inc(device=self.device_id)
+            if reason:
+                log.warning("device %d: stream %d FAILED: %s",
+                            self.device_id, st.stream_id, reason)
+        self._update_stream_gauge_locked()
+
+    def _update_stream_gauge_locked(self) -> None:
+        reg = get_registry()
+        if reg.enabled:
+            reg.gauge(
+                "comm_streams_active",
+                "P2P streams not yet terminal", labels=("device",),
+            ).set(
+                sum(1 for s in self.streams.values() if s.status == pb.IN_PROGRESS),
+                device=self.device_id,
+            )
+
     def stream_status(self, stream_id: int) -> int:
+        stall_s = _env_float("DSML_STREAM_STALL_S", 120.0)
         with self._stream_lock:
             st = self.streams.get(stream_id)
             if st is None:
                 raise DeviceError(grpc.StatusCode.NOT_FOUND, f"unknown stream {stream_id}")
+            # stall detection at the query point: a dropped StreamSend used
+            # to leave an armed receiver IN_PROGRESS forever — a stream with
+            # no progress past the deadline is now a FAILED verdict the
+            # poller can act on (retry / resume from the partial prefix)
+            if (
+                st.status == pb.IN_PROGRESS
+                and stall_s > 0
+                and time.monotonic() - st.last_progress > stall_s
+            ):
+                self._finish_locked(
+                    st, pb.FAILED,
+                    f"stalled: no progress in {stall_s:.0f}s "
+                    f"({st.received}/{st.num_bytes} bytes)",
+                )
             return st.status
+
+    def take_partial(self, stream_id: int) -> bytes:
+        """Harvest the contiguous prefix a dead/stalled stream delivered and
+        mark the stream FAILED — the resumable-offset hook: the migration
+        layer re-requests the remainder from ``len(prefix)`` instead of
+        re-shipping bytes that already arrived."""
+        with self._stream_lock:
+            st = self.streams.get(stream_id)
+            if st is None:
+                raise DeviceError(grpc.StatusCode.NOT_FOUND, f"unknown stream {stream_id}")
+            prefix = b"".join(st.chunks)
+            st.chunks = []
+            st.received = 0
+            self._finish_locked(st, pb.FAILED, "partial prefix harvested for resume")
+            return prefix
 
     # ---- peer table + background push ------------------------------------------
 
@@ -333,33 +448,89 @@ class DeviceRuntime:
                     st.sender_done = True  # a late mismatched arm must FAIL, not hang
                     self._maybe_complete_locked(st, final=True)
             else:
+                # wire-fault injection (chaos harness): the plan may corrupt
+                # the payload, delay the push, truncate the stream mid-send
+                # (drop), or sever the link entirely (partition) — how the
+                # migration path's CRC / timeout / resume story is proven
+                # under fault instead of asserted (runtime.chaos.WireFaultPlan)
+                fault = None
+                from dsml_tpu.runtime import chaos as _chaos
+
+                plan = _chaos.wire_fault_plan()
+                if plan is not None:
+                    fault = plan.on_send(self.self_rank, dst_rank)
+                if fault is not None:
+                    payload = fault.apply_payload(payload)
+                    if fault.action == "partition":
+                        raise RuntimeError(
+                            f"wire fault: link to rank {dst_rank} partitioned"
+                        )
                 stub = self._peer_stub(dst_rank)
 
                 def chunks():
+                    if fault is not None and fault.action == "drop":
+                        # truncate MID-STREAM: deliver half the payload, then
+                        # error the call — the receiver keeps the prefix (the
+                        # resume path's raw material), the sender records FAILED.
+                        # The prefix ships in normal-size chunks (one oversized
+                        # message would hit grpc's 4 MiB cap on big pieces and
+                        # deliver NOTHING, silently skipping the resume path);
+                        # the sleep lets grpc's sender thread flush before the
+                        # cancel, so the prefix actually lands.
+                        cut = max(1, len(payload) // 2)
+                        for off in range(0, cut, _STREAM_CHUNK):
+                            yield pb.DataChunk(data=payload[off : off + _STREAM_CHUNK],
+                                               streamId=stream_id)
+                        time.sleep(0.05)
+                        raise RuntimeError("wire fault: stream dropped")
                     for off in range(0, len(payload), _STREAM_CHUNK):
+                        # progress heartbeat: the stall verdict reads
+                        # last_progress, and a sender mid-push is NOT stalled
+                        # — without this a long multi-GB push would be
+                        # falsely FAILED at DSML_STREAM_STALL_S off its
+                        # creation timestamp
+                        with self._stream_lock:
+                            sending = self.streams.get(stream_id)
+                            if sending is not None:
+                                sending.last_progress = time.monotonic()
                         yield pb.DataChunk(data=payload[off : off + _STREAM_CHUNK], streamId=stream_id)
 
                 ok = stub.StreamSend(chunks()).success
                 with self._stream_lock:
-                    self.streams[stream_id].status = pb.SUCCESS if ok else pb.FAILED
+                    self._finish_locked(
+                        self.streams[stream_id],
+                        pb.SUCCESS if ok else pb.FAILED,
+                        "" if ok else "receiver reported failure",
+                    )
         except Exception as e:  # noqa: BLE001 — background thread must record failure
             log.warning("device %d: stream %d push failed: %s", self.device_id, stream_id, e)
             with self._stream_lock:
-                self.streams[stream_id].status = pb.FAILED
+                self._finish_locked(self.streams[stream_id], pb.FAILED, f"push failed: {e}")
         self._gc_streams()
 
     _MAX_STREAMS = 4096
 
     def _gc_streams(self) -> None:
-        """Evict oldest terminal streams so a long-lived server doesn't grow
-        its stream table without bound."""
+        """Stream-table hygiene: terminal streams are evicted after a TTL
+        (``DSML_STREAM_TTL_S``, default 300 s) — completed/FAILED entries
+        used to accumulate for the life of the process — with the size cap
+        kept as a backstop for pathological churn inside one TTL window."""
+        ttl_s = _env_float("DSML_STREAM_TTL_S", 300.0)
+        now = time.monotonic()
         with self._stream_lock:
-            if len(self.streams) <= self._MAX_STREAMS:
-                return
-            for sid in [s.stream_id for s in self.streams.values() if s.status != pb.IN_PROGRESS]:
+            expired = [
+                sid for sid, s in self.streams.items()
+                if s.done_at is not None and now - s.done_at > ttl_s
+            ]
+            for sid in expired:
                 del self.streams[sid]
-                if len(self.streams) <= self._MAX_STREAMS // 2:
-                    break
+            if len(self.streams) > self._MAX_STREAMS:
+                for sid in [s.stream_id for s in self.streams.values()
+                            if s.status != pb.IN_PROGRESS]:
+                    del self.streams[sid]
+                    if len(self.streams) <= self._MAX_STREAMS // 2:
+                        break
+            self._update_stream_gauge_locked()
 
     # ---- on-device compute ------------------------------------------------------
 
@@ -520,6 +691,13 @@ def serve_device(
     from dsml_tpu.obs.cluster import ObsServicer, current_role
 
     rpc.add_obs_servicer(ObsServicer(current_role("device_server")), server)
+    # shard-migration plane (same port): this host serves pieces of
+    # whatever state its StateDonor registers (runtime.donor) — the elastic
+    # cross-host recovery path (comm/migration.py, docs/ELASTIC.md)
+    from dsml_tpu.comm.migration import MigrationServicer, StateDonor
+
+    runtime.donor = StateDonor(runtime)
+    rpc.add_migration_servicer(MigrationServicer(runtime.donor), server)
     bound = server.add_insecure_port(f"{host}:{port}")
     server.start()
     runtime.bound_address = f"{host}:{bound}"

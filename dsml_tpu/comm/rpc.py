@@ -53,10 +53,22 @@ _OBS_METHODS = {
     "PushSnapshot": ("uu", None, None),
 }
 
+# Shard-migration control plane — a second raw-JSON extension service
+# (same pattern as ObsPlane; reference proto untouched). Only the CONTROL
+# messages ride here: which pieces a donor holds (PlanPieces) and the
+# request to serialize + BeginSend them (BeginMigration, whose response
+# carries stream ids and CRC32C frame checksums). The piece BYTES move
+# over the gpu_sim P2P stream RPCs the donor initiates.
+_MIGRATION_METHODS = {
+    "PlanPieces": ("uu", None, None),
+    "BeginMigration": ("uu", None, None),
+}
+
 _SERVICES = {
     "gpu_sim.GPUDevice": _DEVICE_METHODS,
     "gpu_sim.GPUCoordinator": _COORDINATOR_METHODS,
     "dsml_obs.ObsPlane": _OBS_METHODS,
+    "dsml_migrate.ShardMigration": _MIGRATION_METHODS,
 }
 
 
@@ -121,3 +133,11 @@ def add_coordinator_servicer(servicer, server: grpc.Server) -> None:
 
 def add_obs_servicer(servicer, server: grpc.Server) -> None:
     add_servicer_to_server("dsml_obs.ObsPlane", servicer, server)
+
+
+def migration_stub(channel: grpc.Channel) -> _Stub:
+    return _Stub(channel, "dsml_migrate.ShardMigration")
+
+
+def add_migration_servicer(servicer, server: grpc.Server) -> None:
+    add_servicer_to_server("dsml_migrate.ShardMigration", servicer, server)

@@ -190,6 +190,8 @@ def reconfigure(
     batch_per_device: int = 1,
     global_batch: int | None = None,
     planner_overrides: dict | None = None,
+    migrator=None,
+    non_addressable=(),
 ) -> ElasticState:
     """Continue training on the survivor fleet.
 
@@ -252,7 +254,10 @@ def reconfigure(
     # fetched whole; device_put lays the state out fresh on the new mesh
     pspecs = model.param_specs(pp=plan.spec.pp > 1, fsdp=plan.spec.fsdp)
 
-    host_params, host_opt = _pull_host_state(params, opt_state, lost_devices)
+    host_params, host_opt = _pull_host_state(
+        params, opt_state, lost_devices,
+        migrator=migrator, non_addressable=non_addressable,
+    )
     if old_pp:
         # the failed mesh ran a pipeline (stacked layer axis, possibly in
         # interleave-permuted order for the OLD stage count) — always return
@@ -273,48 +278,76 @@ def reconfigure(
     )
 
 
-def _pull_host_state(params, opt_state, lost_devices):
+def _pull_host_state(params, opt_state, lost_devices, migrator=None,
+                     non_addressable=()):
     """One host round-trip for the whole training state, never touching a
     dead device: leaves whose shards all live on survivors fetch plainly;
     leaves with dead holders reassemble piecewise from surviving addressable
     shards (pieces whose holders ALL died stay zero — the audited torn-state
-    substitution). Shared by :func:`reconfigure` and :func:`reshard_onto`."""
+    substitution). Shared by :func:`reconfigure` and :func:`reshard_onto`.
+
+    A piece that survives only on a NON-addressable device (another host)
+    cannot be fetched from here. With a ``migrator``
+    (``comm.migration.ShardMigrator``), exactly those pieces are pulled
+    over the P2P streams from the donor host and spliced into the piecewise
+    buffer — the cross-host elastic state motion (docs/ELASTIC.md
+    § Multi-host recovery); the leaf key handed to the migrator is the tree
+    path (``params/layers/0/attn/wqkv``), matching what the donor's
+    ``StateDonor.register_state`` derives from the same tree. Without one,
+    the refusal stays loud — never zero silently-good data the audit said
+    was safe. ``non_addressable`` (device ids or devices) forces local
+    devices to be treated as another host's — the single-process simulation
+    hook the multi-host tests and the chaos migration smoke drive."""
     lost_ids = {d.id for d in lost_devices}
+    remote_ids = {getattr(d, "id", d) for d in non_addressable}
+    unreachable = lost_ids | remote_ids
 
-    def pull(leaf):
-        sharding = getattr(leaf, "sharding", None)
-        if (
-            not isinstance(leaf, jax.Array)
-            or sharding is None
-            or not lost_ids
-            or not any(d.id in lost_ids for d in sharding.device_set)
-        ):
-            # no shard of this leaf touches a dead device: plain fetch
-            return jax.device_get(leaf)
-        # some holder died (torn or not): NEVER device_get the whole leaf —
-        # that would materialize dead shards and hang on a real loss.
-        # Reassemble piecewise from surviving addressable shards; pieces
-        # whose holders all died stay zero (audited by the caller); a piece
-        # that survives only on a NON-addressable device (another host)
-        # can't be fetched from here — refuse loudly rather than zero
-        # silently-good data the audit said was safe
-        out = np.zeros(leaf.shape, jnp.dtype(leaf.dtype))
-        filled: set = set()
-        for shard in leaf.addressable_shards:
-            if shard.device.id not in lost_ids:
-                out[shard.index] = np.asarray(shard.data)
-                filled.add(_piece_key(shard.index, leaf.shape))
-        for piece, devs in _piece_holders(leaf, sharding).items():
-            if piece in filled or all(d in lost_ids for d in devs):
-                continue
-            raise RuntimeError(
-                f"piece {piece} of a shape-{leaf.shape} leaf survives only on "
-                f"non-addressable devices {devs}; cross-host state motion is "
-                "not implemented — restore from checkpoint on this host instead"
-            )
-        return out
+    def pull(prefix):
+        def inner(path, leaf):
+            sharding = getattr(leaf, "sharding", None)
+            if (
+                not isinstance(leaf, jax.Array)
+                or sharding is None
+                or not unreachable
+                or not any(d.id in unreachable for d in sharding.device_set)
+            ):
+                # no shard of this leaf touches a dead/remote device: plain fetch
+                return jax.device_get(leaf)
+            # some holder died or sits on another host: NEVER device_get the
+            # whole leaf — that would materialize dead shards and hang on a
+            # real loss. Reassemble piecewise from surviving addressable
+            # shards; pieces whose holders all died stay zero (audited by
+            # the caller); remote-only survivors migrate or refuse.
+            out = np.zeros(leaf.shape, jnp.dtype(leaf.dtype))
+            filled: set = set()
+            for shard in leaf.addressable_shards:
+                if shard.device.id not in unreachable:
+                    out[shard.index] = np.asarray(shard.data)
+                    filled.add(_piece_key(shard.index, leaf.shape))
+            for piece, devs in _piece_holders(leaf, sharding).items():
+                if piece in filled or all(d in lost_ids for d in devs):
+                    continue
+                if migrator is None:
+                    raise RuntimeError(
+                        f"piece {piece} of a shape-{leaf.shape} leaf survives only "
+                        f"on non-addressable devices {devs}; no ShardMigrator is "
+                        "wired — restore from checkpoint on this host instead "
+                        "(docs/ELASTIC.md § Multi-host recovery)"
+                    )
+                from dsml_tpu.comm.migration import tree_path_str
 
-    return jax.tree.map(pull, params), jax.tree.map(pull, opt_state)
+                idx = tuple(slice(s, e) for s, e in piece)
+                out[idx] = migrator.fetch_piece(
+                    tree_path_str(prefix, path), piece, out.dtype
+                )
+            return out
+
+        return inner
+
+    return (
+        jax.tree_util.tree_map_with_path(pull("params"), params),
+        jax.tree_util.tree_map_with_path(pull("opt_state"), opt_state),
+    )
 
 
 def _detect_stacked_pp(params) -> int:
@@ -337,6 +370,8 @@ def reshard_onto(
     mesh: Mesh,
     spec: MeshSpec,
     lost_devices=(),
+    migrator=None,
+    non_addressable=(),
 ) -> ElasticState:
     """Move LIVE state onto a KNOWN mesh — the grow-back primitive.
 
@@ -347,7 +382,10 @@ def reshard_onto(
     trajectory bit-comparable to the pre-failure one. Same host round-trip
     / unstack / restack / place pipeline as :func:`reconfigure`."""
     cfg = getattr(model, "config", None)
-    host_params, host_opt = _pull_host_state(params, opt_state, lost_devices)
+    host_params, host_opt = _pull_host_state(
+        params, opt_state, lost_devices,
+        migrator=migrator, non_addressable=non_addressable,
+    )
     old_pp = _detect_stacked_pp(params)
     if old_pp:
         host_params, host_opt = _unstack_state(host_params, host_opt, cfg, old_pp)

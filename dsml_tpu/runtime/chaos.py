@@ -35,6 +35,8 @@ from __future__ import annotations
 import dataclasses
 import os
 import random
+import threading
+import time
 
 import numpy as np
 
@@ -44,10 +46,15 @@ __all__ = [
     "ChaosEvent",
     "ChaosSchedule",
     "VirtualFleet",
+    "WireFault",
+    "WireFaultPlan",
+    "wire_fault_plan",
+    "set_wire_fault_plan",
     "config_from_env",
     "run_chaos_training",
     "run_chaos_serving",
     "run_smoke",
+    "run_migration_smoke",
 ]
 
 log = get_logger("chaos")
@@ -164,6 +171,147 @@ class VirtualFleet:
     @property
     def n_dead(self) -> int:
         return len(self._dead)
+
+
+# ---------------------------------------------------------------------------
+# wire faults: the DATA-PLANE adversary (P2P streams)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class WireFault:
+    """One data-plane fault, applied by ``device_server._push_stream``:
+
+    - ``drop`` — truncate the StreamSend mid-stream (the receiver keeps a
+      partial prefix, the sender's call errors);
+    - ``corrupt`` — flip one byte mid-payload (exactly what the migration
+      path's per-chunk CRC32C must catch);
+    - ``delay`` — sleep ``delay_s`` before pushing (timeout exercise);
+    - ``partition`` — sever the link: the push fails before any byte moves.
+
+    ``nth`` selects the 1-based send ordinal the fault fires on (None =
+    every matching send); ``src``/``dst`` restrict to one link."""
+
+    action: str
+    nth: int | None = None
+    src: int | None = None
+    dst: int | None = None
+    delay_s: float = 0.1
+
+    _ACTIONS = ("drop", "corrupt", "delay", "partition")
+
+    def __post_init__(self):
+        if self.action not in self._ACTIONS:
+            raise ValueError(f"unknown wire-fault action {self.action!r}")
+
+    def matches(self, ordinal: int, src, dst) -> bool:
+        if self.nth is not None and ordinal != self.nth:
+            return False
+        if self.src is not None and src != self.src:
+            return False
+        if self.dst is not None and dst != self.dst:
+            return False
+        return True
+
+    def apply_payload(self, payload: bytes) -> bytes:
+        if self.action == "corrupt":
+            mutated = bytearray(payload)
+            if mutated:
+                mutated[len(mutated) // 2] ^= 0xFF
+            return bytes(mutated)
+        if self.action == "delay":
+            time.sleep(self.delay_s)
+        return payload
+
+
+class WireFaultPlan:
+    """A per-link wire-fault schedule, keyed on the process-wide send
+    ordinal. Spec grammar (``DSML_CHAOS_WIRE``): semicolon-separated
+    ``action@sel[,src=N][,dst=N][,s=SECONDS]`` where ``sel`` is a 1-based
+    send ordinal or ``*`` (every send) — e.g.
+    ``"drop@1;corrupt@3"`` or ``"delay@*,dst=1,s=0.05"``."""
+
+    def __init__(self, faults):
+        self.faults = list(faults)
+        self._sends = 0
+        self._lock = threading.Lock()
+        self.fired: list[dict] = []
+
+    @classmethod
+    def parse(cls, spec: str) -> "WireFaultPlan":
+        faults = []
+        for token in spec.split(";"):
+            token = token.strip().lower()
+            if not token:
+                continue
+            head, _, rest = token.partition(",")
+            if "@" not in head:
+                raise ValueError(f"wire-fault token {token!r}: expected action@sel")
+            action, sel = head.split("@", 1)
+            fault = {"action": action.strip(),
+                     "nth": None if sel.strip() == "*" else int(sel)}
+            for kv in rest.split(","):
+                kv = kv.strip()
+                if not kv:
+                    continue
+                k, _, v = kv.partition("=")
+                if k == "src":
+                    fault["src"] = int(v)
+                elif k == "dst":
+                    fault["dst"] = int(v)
+                elif k == "s":
+                    fault["delay_s"] = float(v)
+                else:
+                    raise ValueError(f"wire-fault token {token!r}: unknown key {k!r}")
+            faults.append(WireFault(**fault))
+        return cls(faults)
+
+    def on_send(self, src, dst) -> WireFault | None:
+        """Called by the device server once per outbound stream push;
+        returns the fault to apply (if any) and records the firing."""
+        with self._lock:
+            self._sends += 1
+            ordinal = self._sends
+            for fault in self.faults:
+                if fault.matches(ordinal, src, dst):
+                    self.fired.append(
+                        {"action": fault.action, "ordinal": ordinal,
+                         "src": src, "dst": dst}
+                    )
+                    log.warning("wire fault: %s on send #%d (%s -> %s)",
+                                fault.action, ordinal, src, dst)
+                    from dsml_tpu.obs import get_registry
+
+                    reg = get_registry()
+                    if reg.enabled:
+                        reg.counter(
+                            "chaos_wire_faults_total",
+                            "injected data-plane faults", labels=("action",),
+                        ).inc(action=fault.action)
+                    return fault
+        return None
+
+
+_WIRE_UNSET = object()
+_WIRE_PLAN = _WIRE_UNSET
+
+
+def wire_fault_plan() -> WireFaultPlan | None:
+    """The process's active wire-fault plan: whatever
+    :func:`set_wire_fault_plan` installed, else ``DSML_CHAOS_WIRE`` parsed
+    once (None when unset/empty — the zero-cost production answer)."""
+    global _WIRE_PLAN
+    if _WIRE_PLAN is _WIRE_UNSET:
+        spec = os.environ.get("DSML_CHAOS_WIRE", "").strip()
+        _WIRE_PLAN = WireFaultPlan.parse(spec) if spec else None
+    return _WIRE_PLAN
+
+
+def set_wire_fault_plan(plan: WireFaultPlan | None) -> None:
+    """Install (or, with None, clear) the active plan — the in-process
+    test hook; subprocesses use the env knob."""
+    global _WIRE_PLAN
+    _WIRE_PLAN = plan
 
 
 def run_chaos_training(controller, schedule: ChaosSchedule,
@@ -371,6 +519,374 @@ def _serving_smoke(model, cfg, rng) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# migration smoke: the two-host (subprocess-simulated) shrink, under fault
+# ---------------------------------------------------------------------------
+
+_DONOR_FLAG = "--serve-migration-donor"
+
+
+def _donor_main(npz_path: str) -> None:
+    """Subprocess body: the DONOR HOST. Loads the state snapshot (the
+    addressable view a real donor host would hold live), registers every
+    leaf with its device server's StateDonor, prints the bound address as
+    a JSON line, and serves P2P streams until stdin closes. Wire faults
+    ride ``DSML_CHAOS_WIRE`` in this process's env — the donor is the
+    stream SENDER, so drop/corrupt/delay happen on its pushes."""
+    import json as _json
+    import sys
+
+    from dsml_tpu.comm.device_server import serve_device
+
+    blob = np.load(npz_path)
+    # the staging allocator gets the upper half of the registry, so size
+    # the device for 2x the largest piece plus the landing headroom
+    total = int(sum(blob[k].nbytes for k in blob.files))
+    handle = serve_device(97, mem_size=max(0x200000, 4 * total))
+    for key in blob.files:
+        if key == "__migration_version__":
+            handle.runtime.donor.version = int(blob[key])
+            continue
+        handle.runtime.donor.register_array(key, blob[key])
+    print(_json.dumps({"address": handle.address, "keys": len(blob.files)}),
+          flush=True)
+    sys.stdin.read()  # parent closes the pipe → exit
+    handle.stop()
+
+
+def _export_state_npz(path: str, params, opt_state, version: int) -> int:
+    """Host-state snapshot in the donor registry's key scheme (tree paths
+    under ``params/`` / ``opt_state/`` — what ``StateDonor.register_state``
+    derives from the same trees), stamped with the snapshot's training
+    step so the receiver can refuse a stale donor."""
+    import jax
+
+    from dsml_tpu.comm.migration import tree_path_str
+
+    arrays = {"__migration_version__": np.asarray(version)}
+    for prefix, tree in (("params", params), ("opt_state", opt_state)):
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        for p, leaf in flat:
+            if leaf is not None and hasattr(leaf, "shape"):
+                arrays[tree_path_str(prefix, p)] = np.asarray(jax.device_get(leaf))
+    np.savez(path, **arrays)
+    return len(arrays) - 1
+
+
+def _bit_identical_host(tree_a, tree_b) -> bool:
+    import jax
+
+    la = [np.asarray(jax.device_get(x)) for x in jax.tree.leaves(tree_a)]
+    lb = [np.asarray(jax.device_get(x)) for x in jax.tree.leaves(tree_b)]
+    return len(la) == len(lb) and all(np.array_equal(a, b) for a, b in zip(la, lb))
+
+
+def run_migration_smoke(tmp_dir: str | None = None, reps: int = 1) -> dict:
+    """The two-host shrink acceptance run (docs/ELASTIC.md § Multi-host
+    recovery): host A (this process) shards GPT2-tiny over [dp=4, tp=2],
+    loses its local tp-1 holders, and the surviving copies of that shard
+    live only on "host B" — a donor SUBPROCESS serving the state over the
+    real gRPC P2P streams, routed through the coordinator's membership
+    table. Four legs:
+
+    - ``refusal`` — without a migrator the pull refuses loudly (the pinned
+      pre-PR behavior; a shrink would degrade to checkpoint restore);
+    - ``clean`` — the same shrink completes via P2P migration, no
+      checkpoint restore, params BIT-IDENTICAL to what the checkpoint
+      fallback would produce;
+    - ``drop`` — one dropped StreamSend: the migrator harvests the partial
+      prefix and resumes from the offset; same bits;
+    - ``corrupt`` — every push corrupted: per-chunk CRC32C fires, the
+      migration aborts cleanly, and an ``ElasticController`` riding the
+      same failure falls back to the coordinated checkpoint restore with
+      ZERO silent corruption (corrupt bytes never land).
+
+    ``reps`` repeats the clean migration + fallback timing pair for the
+    bench's recovery-split percentiles. ``verify_migration`` raises the
+    violations; the CLI exits nonzero on any."""
+    import json
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    import time as _time
+
+    import jax
+    import optax
+
+    from dsml_tpu.checkpoint import CheckpointManager
+    from dsml_tpu.comm.coordinator import CoordinatorConfig, serve_coordinator
+    from dsml_tpu.comm.device_server import serve_device
+    from dsml_tpu.comm.migration import (
+        MigrationConfig,
+        MigrationError,
+        ShardMigrator,
+    )
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.parallel import elastic
+    from dsml_tpu.parallel.hybrid import (
+        init_hybrid,
+        make_hybrid_train_step,
+        shard_params,
+    )
+    from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    devices = jax.devices()[:8]
+    if len(devices) < 8:
+        raise RuntimeError(f"migration smoke needs 8 devices, found {len(devices)}")
+    base = tmp_dir or tempfile.mkdtemp(prefix="dsml_migrate_")
+    created = tmp_dir is None
+    report: dict = {}
+    procs: list = []
+    coordinator = None
+    recv = None
+    try:
+        cfg = GPT2Config.tiny()
+        model = GPT2(cfg)
+        optimizer = optax.adam(1e-2)
+        global_batch = 8
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, cfg.vocab_size,
+                            (16, global_batch, cfg.max_seq)).astype(np.int32)
+
+        def batch_provider(step: int):
+            x = data[step - 1]
+            return x, np.roll(x, -1, 1).astype(np.int32)
+
+        # host A's live state: 2 steps on [dp=4, tp=2] (device i holds tp
+        # rank i%2 — losing {1,3} removes every LOCAL copy of tp shard 1;
+        # devices 4..7 play host B, so the shard SURVIVES, remotely)
+        spec = MeshSpec(dp=4, sp=1, tp=2)
+        mesh8 = build_mesh(spec, devices)
+        step_fn = make_hybrid_train_step(model, optimizer, mesh8)
+        params, opt_state = init_hybrid(model, optimizer, mesh8, seed=0)
+        for s in (1, 2):
+            params, opt_state, _ = step_fn(params, opt_state, *batch_provider(s))
+        # re-pin DECLARED shardings (jit outputs carry compiler-chosen
+        # layouts; the elastic runner's own idiom — see test_elastic)
+        import optax.tree_utils as otu
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        pspecs = model.param_specs()
+        params = shard_params(params, mesh8, pspecs)
+        param_sh = jax.tree.map(lambda sp: NamedSharding(mesh8, sp), pspecs,
+                                is_leaf=lambda sp: isinstance(sp, P))
+        repl = NamedSharding(mesh8, P())
+        opt_state = otu.tree_map_params(
+            optimizer, lambda l, sh: jax.device_put(l, sh), opt_state, param_sh,
+            transform_non_params=lambda l: jax.device_put(l, repl),
+        )
+
+        ckpt_dir = os.path.join(base, "ckpt")
+        manager = CheckpointManager(ckpt_dir, max_to_keep=None)
+        manager.save(2, {"params": params, "opt_state": opt_state})
+        npz = os.path.join(base, "donor_state.npz")
+        n_leaves = _export_state_npz(npz, params, opt_state, version=2)
+
+        lost = [devices[i] for i in (1, 3)]
+        survivors = [devices[i] for i in (0, 2, 4, 5, 6, 7)]
+        remote_ids = frozenset(devices[i].id for i in (4, 5, 6, 7))
+        recv = serve_device(96, mem_size=0x400000)
+        coordinator = serve_coordinator(
+            config=CoordinatorConfig(health_interval_s=3600.0)
+        )
+
+        def spawn_donor(wire_spec: str) -> str:
+            env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+            env.pop("DSML_CHAOS_WIRE", None)
+            if wire_spec:
+                env["DSML_CHAOS_WIRE"] = wire_spec
+            p = subprocess.Popen(
+                [sys.executable, "-m", "dsml_tpu.runtime.chaos",
+                 _DONOR_FLAG, npz],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env,
+                text=True,
+            )
+            procs.append(p)
+            return json.loads(p.stdout.readline())["address"]
+
+        def migrator_for(donor_addr: str, **cfg_kw) -> ShardMigrator:
+            # coordinator-brokered routing: CommInit installs the peer
+            # tables, the membership table names ranks and addresses; the
+            # receiver pins the snapshot step it expects (the state at the
+            # failure point) so a stale donor would be refused, not landed
+            comm = coordinator.runtime.comm_init(2, [recv.address, donor_addr])
+            self_rank, donors = coordinator.runtime.broker_migration(
+                comm.comm_id, recv.runtime.device_id
+            )
+            return ShardMigrator(
+                recv.runtime, self_rank, donors,
+                config=MigrationConfig(**cfg_kw),
+                local_address=recv.runtime.bound_address,
+                expect_version=2,
+            )
+
+        def reconfigure_with(migrator):
+            return elastic.reconfigure(
+                model, optimizer, params, opt_state,
+                surviving_devices=survivors, lost_devices=lost,
+                global_batch=global_batch,
+                migrator=migrator, non_addressable=remote_ids,
+            )
+
+        # --- leg 0: the pinned refusal (no migrator) ----------------------
+        try:
+            reconfigure_with(None)
+            report["refusal"] = {"raised": False}
+        except RuntimeError as e:
+            report["refusal"] = {
+                "raised": True,
+                "mentions_non_addressable": "non-addressable" in str(e),
+            }
+
+        # --- leg 1: clean migration vs checkpoint fallback, bit-identical -
+        donor_addr = spawn_donor("")
+        mig = migrator_for(donor_addr, timeout_s=30.0)
+        mig_walls, fb_walls = [], []
+        state = fb_state = None
+        for _ in range(max(reps, 1)):
+            t0 = _time.perf_counter()
+            state = reconfigure_with(mig)
+            mig_walls.append((_time.perf_counter() - t0) * 1e3)
+            t0 = _time.perf_counter()
+            fb_state = elastic.restore_from_checkpoint(
+                manager, model, optimizer, survivors,
+                global_batch=global_batch,
+            )
+            fb_walls.append((_time.perf_counter() - t0) * 1e3)
+        report["clean"] = {
+            "migrated_pieces": mig.stats["pieces"],
+            "migrated_bytes": mig.stats["bytes"],
+            "migration_ms": round(mig.stats["ms"], 3),
+            "mb_s": round(
+                (mig.stats["bytes"] / 1e6) / max(mig.stats["ms"] / 1e3, 1e-9), 3
+            ),
+            "reps": max(reps, 1),
+            "recovery_ms_migration": [round(w, 3) for w in mig_walls],
+            "recovery_ms_fallback": [round(w, 3) for w in fb_walls],
+            "bit_identical_to_fallback": _bit_identical_host(
+                (state.params, state.opt_state),
+                (fb_state.params, fb_state.opt_state),
+            ),
+            "used_fallback": False,
+        }
+        mig.close()
+
+        # --- leg 2: one dropped StreamSend → harvested prefix + resume ----
+        donor_addr = spawn_donor("drop@1")
+        mig = migrator_for(donor_addr, timeout_s=30.0)
+        drop_state = reconfigure_with(mig)
+        report["drop"] = {
+            "resumed": mig.stats["resumed"],
+            "retries": mig.stats["retries"],
+            "bit_identical": _bit_identical_host(
+                (drop_state.params, drop_state.opt_state),
+                (state.params, state.opt_state),
+            ),
+        }
+        mig.close()
+
+        # --- leg 3: persistent corruption → CRC fires, controller falls
+        # back to the coordinated checkpoint restore, zero silent landing --
+        donor_addr = spawn_donor("corrupt@*")
+        mig = migrator_for(donor_addr, timeout_s=30.0, retries=1)
+        crc_fired = False
+        try:
+            reconfigure_with(mig)
+        except MigrationError:
+            crc_fired = True
+        from dsml_tpu.runtime.controller import (
+            ControllerConfig,
+            DeviceLost,
+            ElasticController,
+        )
+
+        fleet = VirtualFleet(devices)
+        ctl = ElasticController(
+            model, optimizer, batch_provider,
+            checkpoint_dir=os.path.join(base, "ctl"),
+            fleet=fleet, mesh=mesh8, spec=spec,
+            config=ControllerConfig(checkpoint_every=2, growback="keep"),
+            global_batch=global_batch, seed=0,
+            migrator=mig, non_addressable=remote_ids,
+        )
+
+        def on_step(s: int) -> None:
+            if s == 3:
+                dead = fleet.kill(1, 3)
+                if dead:
+                    ctl.inject(DeviceLost(dead, "chaos: local tp-1 holders"))
+
+        with ctl:
+            ctl_report = ctl.run(4, on_step=on_step)
+        rec = ctl_report["recoveries"][0] if ctl_report["recoveries"] else {}
+        report["corrupt"] = {
+            "crc_fired": crc_fired,
+            "integrity_failures": mig.stats["integrity_failures"],
+            "controller_kind": rec.get("kind"),
+            "controller_fallback_mentions_crc": "CRC" in rec.get("fallback_reason", ""),
+            "controller_steps_completed": ctl_report["steps_completed"],
+            "losses_finite": bool(
+                np.all(np.isfinite(list(ctl.losses.values())))
+            ),
+        }
+        mig.close()
+        report["n_leaves"] = n_leaves
+        manager.close()
+        return report
+    finally:
+        if coordinator is not None:
+            coordinator.stop()
+        if recv is not None:
+            recv.stop()
+        for p in procs:
+            try:
+                p.stdin.close()
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — teardown must not mask the report
+                p.kill()
+        if created:
+            shutil.rmtree(base, ignore_errors=True)
+
+
+def verify_migration(report: dict) -> list[str]:
+    """The migration invariants, as a list of violations (empty = pass)."""
+    bad: list[str] = []
+    refusal = report.get("refusal", {})
+    if not refusal.get("raised"):
+        bad.append("refusal: pull without a migrator did NOT raise")
+    clean = report.get("clean", {})
+    if not clean.get("migrated_pieces"):
+        bad.append("clean: zero pieces moved over P2P streams")
+    if clean.get("used_fallback"):
+        bad.append("clean: migration leg used the checkpoint fallback")
+    if not clean.get("bit_identical_to_fallback"):
+        bad.append("clean: migrated state NOT bit-identical to the "
+                   "checkpoint-fallback state")
+    drop = report.get("drop", {})
+    if not (drop.get("resumed") or drop.get("retries")):
+        bad.append("drop: dropped stream neither resumed nor retried")
+    if not drop.get("bit_identical"):
+        bad.append("drop: resumed migration NOT bit-identical")
+    corrupt = report.get("corrupt", {})
+    if not corrupt.get("crc_fired"):
+        bad.append("corrupt: CRC check did not abort the migration")
+    if not corrupt.get("integrity_failures"):
+        bad.append("corrupt: no integrity failures counted")
+    if corrupt.get("controller_kind") != "checkpoint_fallback":
+        bad.append(
+            f"corrupt: controller recovered via "
+            f"{corrupt.get('controller_kind')!r}, expected checkpoint_fallback"
+        )
+    if corrupt.get("controller_steps_completed", 0) < 4:
+        bad.append("corrupt: controller did not complete the run after fallback")
+    return bad
+
+
 def verify(report: dict) -> list[str]:
     """The invariants, as a list of violations (empty = pass)."""
     bad: list[str] = []
@@ -410,6 +926,15 @@ def _main(argv=None) -> int:
                         help="extra seeded-random schedules")
     parser.add_argument("--report", default="",
                         help="write the JSON report here")
+    parser.add_argument("--migration", action="store_true",
+                        help="run the two-host (subprocess-simulated) "
+                        "shard-migration smoke instead: clean P2P shrink "
+                        "bit-identical to checkpoint fallback, dropped-stream "
+                        "resume, corrupt-chunk CRC abort + coordinated "
+                        "fallback (docs/ELASTIC.md § Multi-host recovery); "
+                        "exits nonzero on any violated invariant")
+    parser.add_argument(_DONOR_FLAG, default=None, metavar="NPZ",
+                        help=argparse.SUPPRESS)
     parser.add_argument("--cluster-snapshot", default="",
                         help="write this process's cluster-obs snapshot "
                         "(registry + trace, identity-stamped) here so an "
@@ -421,10 +946,27 @@ def _main(argv=None) -> int:
                         "(or in addition to) writing a file")
     args = parser.parse_args(argv)
 
+    if args.serve_migration_donor is not None:
+        _donor_main(args.serve_migration_donor)
+        return 0
+
     # force the virtual-8 CPU mesh BEFORE jax initializes a backend
     from dsml_tpu.utils.platform import configure_platform
 
     configure_platform("cpu", 8)
+
+    if args.migration:
+        report = run_migration_smoke()
+        violations = verify_migration(report)
+        report["violations"] = violations
+        line = json.dumps(report, default=str)
+        print(line)
+        if args.report:
+            with open(args.report, "w") as f:
+                f.write(line + "\n")
+        for v in violations:
+            log.error("migration invariant violated: %s", v)
+        return 1 if violations else 0
 
     want_obs = bool(args.cluster_snapshot or args.push)
     if want_obs:

@@ -178,6 +178,8 @@ class ElasticController:
         step_factory: Callable | None = None,
         failure_feed: Callable[[], list] | None = None,
         planner_overrides: dict | None = None,
+        migrator=None,
+        non_addressable=(),
     ):
         from dsml_tpu.checkpoint import CheckpointManager
 
@@ -189,6 +191,13 @@ class ElasticController:
         self.global_batch = global_batch
         self.seed = seed
         self.planner_overrides = planner_overrides
+        # cross-host state motion (docs/ELASTIC.md § Multi-host recovery):
+        # with a ShardMigrator wired, a shrink whose pieces survive only on
+        # another host pulls them over the P2P streams instead of falling
+        # back to a checkpoint; `non_addressable` marks device ids that
+        # belong to other hosts (the single-process sim lists local ids)
+        self.migrator = migrator
+        self.non_addressable = tuple(non_addressable)
         self._step_factory = step_factory or (
             lambda mdl, opt, m: make_hybrid_train_step(
                 mdl, opt, m, attn_impl=self.config.attn_impl
@@ -460,6 +469,14 @@ class ElasticController:
             )
         lost_in_mesh = [d for d in self.mesh.devices.flat if d.id in lost_ids]
         lost_steps = 0
+        extra: dict = {}
+        mig_before = None
+        if self.migrator is not None:
+            # donor death verdicts and cached plans are scoped to ONE
+            # recovery: a donor that flaked last outage may be healthy now
+            if hasattr(self.migrator, "reset_donors"):
+                self.migrator.reset_donors()
+            mig_before = dict(self.migrator.stats)
         try:
             state = elastic.reconfigure(
                 self.model, self.optimizer, self.params, self.opt_state,
@@ -468,16 +485,22 @@ class ElasticController:
                 batch_per_device=self.config.batch_per_device,
                 global_batch=self.global_batch,
                 planner_overrides=self.planner_overrides,
+                migrator=self.migrator,
+                non_addressable=self.non_addressable,
             )
             kind = "reconfigure"
         except RuntimeError as e:
             if "allow_shrink=False" in str(e):
                 raise  # fail-fast policy: the reference's semantics, chosen
-            # torn state: the Varuna-style fallback — flush in-flight saves,
-            # restore the latest commit onto the survivor plan, and rewind
-            # the step counter to it (the replayed steps are the lost work)
+            # torn state (or P2P migration undeliverable): the Varuna-style
+            # COORDINATED fallback — flush in-flight saves, restore the
+            # latest commit onto the survivor plan, and rewind the step
+            # counter to it (the replayed steps are the lost work). In a
+            # real multi-host fleet every host takes this leg on the step
+            # CheckpointManager.newest_common_step agrees on.
             log.warning("live state not recoverable (%s); falling back to "
                         "checkpoint", e)
+            extra["fallback_reason"] = str(e)[:200]
             self._ckpt.wait_until_finished()
             try:
                 state = elastic.restore_from_checkpoint(
@@ -494,11 +517,22 @@ class ElasticController:
             lost_steps = max((self._step - 1) - state.step, 0)
             self._rewind(state.step)
             kind = "checkpoint_fallback"
+        if mig_before is not None:
+            stats = self.migrator.stats
+            delta = {k: stats[k] - mig_before[k] for k in mig_before}
+            if delta.get("pieces") or delta.get("bytes") or \
+                    delta.get("integrity_failures") or delta.get("retries"):
+                extra.update({
+                    "migrated_pieces": delta["pieces"],
+                    "migrated_bytes": delta["bytes"],
+                    "migration_resumed": delta["resumed"],
+                    "migration_integrity_failures": delta["integrity_failures"],
+                })
         self._adopt(state)
         self._pure = False
         recovery_ms = (time.perf_counter() - t0) * 1e3
         self._finish_recovery(kind, recovery_ms, width_before, lost_steps,
-                              sorted(lost_ids))
+                              sorted(lost_ids), extra=extra)
 
     def _adopt(self, state) -> None:
         self.params, self.opt_state = state.params, state.opt_state
@@ -523,7 +557,7 @@ class ElasticController:
 
     def _finish_recovery(self, kind: str, recovery_ms: float,
                          width_before: int, lost_steps: int,
-                         lost_ids: list) -> None:
+                         lost_ids: list, extra: dict | None = None) -> None:
         observe_recovery_ms(kind, recovery_ms)
         self._registry.counter(
             "controller_recoveries_total", "controller recovery actions",
@@ -550,6 +584,7 @@ class ElasticController:
             "lost_steps": lost_steps, "lost_devices": lost_ids,
             "resume_step": self._step,
         }
+        rec.update(extra or {})
         self.recoveries.append(rec)
         self._recorder.record(
             "controller_recovered",
@@ -656,6 +691,8 @@ class ElasticController:
                 state = elastic.reshard_onto(
                     self.model, self.optimizer, self.params, self.opt_state,
                     self._full_mesh, self._full_spec,
+                    migrator=self.migrator,
+                    non_addressable=self.non_addressable,
                 )
             else:
                 state = elastic.reconfigure(
@@ -665,6 +702,8 @@ class ElasticController:
                     batch_per_device=self.config.batch_per_device,
                     global_batch=self.global_batch,
                     planner_overrides=self.planner_overrides,
+                    migrator=self.migrator,
+                    non_addressable=self.non_addressable,
                 )
             self._adopt(state)
             kind = "grow_keep"
@@ -707,12 +746,38 @@ class DecodeFleet:
         max_replicas: int = 4,
         scale_up_queue_depth: int = 4,
         scale_down_idle_ticks: int = 16,
+        devices=None,
+        devices_per_replica: int = 1,
     ):
         if min_replicas < 1 or max_replicas < min_replicas:
             raise ValueError(
                 f"need 1 <= min_replicas <= max_replicas; got "
                 f"{min_replicas}, {max_replicas}"
             )
+        # device pool: with `devices` set, each replica SPANS
+        # `devices_per_replica` chips — `make_replica(devices_tuple)` builds
+        # it (serving.ContinuousBatcher.for_devices is the canonical
+        # factory). A killed replica's chips return to the pool, so its
+        # respawn — and the requeued work's failover onto survivors —
+        # exercises the same multi-device state motion training recovery
+        # does. Without `devices`, `make_replica()` keeps the historical
+        # zero-arg contract.
+        if devices_per_replica < 1:
+            raise ValueError(
+                f"devices_per_replica must be >= 1, got {devices_per_replica}"
+            )
+        self._device_pool: list | None = list(devices) if devices is not None else None
+        self.devices_per_replica = devices_per_replica
+        if self._device_pool is not None:
+            capacity = len(self._device_pool) // devices_per_replica
+            if capacity < min_replicas:
+                raise ValueError(
+                    f"{len(self._device_pool)} pooled device(s) cannot back "
+                    f"min_replicas={min_replicas} at {devices_per_replica} "
+                    "device(s) per replica"
+                )
+            max_replicas = min(max_replicas, capacity)
+        self._replica_devices: dict[int, tuple] = {}
         self._make = make_replica
         self.min_replicas = min_replicas
         self.max_replicas = max_replicas
@@ -741,7 +806,27 @@ class DecodeFleet:
     def _spawn(self, reason: str) -> int:
         rid = self._next_replica
         self._next_replica += 1
-        replica = self._replicas[rid] = self._make()
+        if self._device_pool is not None:
+            if len(self._device_pool) < self.devices_per_replica:
+                self._next_replica -= 1
+                raise RuntimeError(
+                    f"device pool exhausted: {len(self._device_pool)} free, "
+                    f"{self.devices_per_replica} needed per replica"
+                )
+            span = tuple(self._device_pool[: self.devices_per_replica])
+            del self._device_pool[: self.devices_per_replica]
+            self._replica_devices[rid] = span
+            try:
+                replica = self._replicas[rid] = self._make(span)
+            except BaseException:
+                # a failed factory must return its chips: nothing will ever
+                # retire/kill this rid, so leaking here would permanently
+                # shrink fleet capacity one replica-span per failure
+                self._release_devices(rid)
+                self._next_replica -= 1
+                raise
+        else:
+            replica = self._replicas[rid] = self._make()
         # stamp the replica id into the batcher's serving metrics
         # (admissions / occupancy / queue depth / tokens / sheds) so the
         # cluster aggregator sees per-replica series, not one blended
@@ -752,9 +837,15 @@ class DecodeFleet:
         self._note_scale("up", rid, reason)
         return rid
 
+    def _release_devices(self, rid: int) -> None:
+        span = self._replica_devices.pop(rid, None)
+        if span is not None and self._device_pool is not None:
+            self._device_pool.extend(span)
+
     def _retire(self, rid: int, reason: str) -> None:
         self._replicas.pop(rid)
         self._idle_ticks.pop(rid, None)
+        self._release_devices(rid)
         self._note_scale("down", rid, reason)
 
     def _note_scale(self, direction: str, rid: int, reason: str) -> None:
@@ -785,6 +876,7 @@ class DecodeFleet:
             rid = max(self._replicas)
         replica = self._replicas.pop(rid)
         self._idle_ticks.pop(rid, None)
+        self._release_devices(rid)
         self._harvest(rid, replica.collect())
         requeued = 0
         for req in reversed(replica.abandon()):

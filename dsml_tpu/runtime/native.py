@@ -54,7 +54,7 @@ def _load():
             log.warning("native runtime load failed (%s); using Python fallbacks", e)
             _lib = False
             return False
-        if not hasattr(lib, "ds_prefetch_new"):
+        if not hasattr(lib, "ds_crc32c"):
             # only reachable when make was unavailable and an old .so was
             # the best we had — degrade for this process; the next process
             # with a toolchain rebuilds
@@ -95,6 +95,8 @@ def _load():
         lib.ds_prefetch_next.restype = ctypes.c_int64
         lib.ds_prefetch_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
         lib.ds_prefetch_free.argtypes = [ctypes.c_void_p]
+        lib.ds_crc32c.restype = ctypes.c_uint32
+        lib.ds_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
         _lib = lib
         return lib
 
@@ -197,6 +199,44 @@ def reduce_f32(rows: np.ndarray, op: int) -> np.ndarray:
     combine = {0: np.add.reduce, 1: np.multiply.reduce, 2: np.minimum.reduce,
                3: np.maximum.reduce, 4: lambda a: np.add.reduce(a) / a.shape[0]}[int(op)]
     return combine(rows).astype(np.float32)
+
+
+_CRC32C_TABLE: list | None = None
+
+
+def _crc32c_table() -> list:
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        tbl = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            tbl.append(c)
+        _CRC32C_TABLE = tbl
+    return _CRC32C_TABLE
+
+
+def _crc32c_py(data: bytes, crc: int = 0) -> int:
+    """Pure-Python CRC32C (Castagnoli) — the bit-identical fallback for
+    :func:`crc32c` when the native library is unavailable."""
+    tbl = _crc32c_table()
+    c = ~crc & 0xFFFFFFFF
+    for b in data:
+        c = tbl[(c ^ b) & 0xFF] ^ (c >> 8)
+    return ~c & 0xFFFFFFFF
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """CRC32C (Castagnoli) of ``data``; pass the previous return value as
+    ``crc`` to roll the checksum across chunks. The frame checksum of the
+    P2P shard-migration path (``comm.migration``): the C kernel when the
+    library is built, the table-driven Python fallback otherwise."""
+    data = bytes(data) if not isinstance(data, (bytes, bytearray)) else data
+    lib = _load()
+    if lib:
+        return int(lib.ds_crc32c(bytes(data), len(data), crc))
+    return _crc32c_py(bytes(data), crc)
 
 
 class NativePrefetcher:

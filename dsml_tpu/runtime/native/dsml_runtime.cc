@@ -380,4 +380,33 @@ void ds_prefetch_free(void* handle) {
   delete p;
 }
 
+// ---------------------------------------------------------------------------
+// crc32c — Castagnoli CRC (poly 0x1EDC6F41, reflected 0x82F63B78), the
+// frame checksum of the P2P shard-migration path (comm/migration.py).
+// Table-driven byte-at-a-time: sequential-dependency CRCs cannot be
+// vectorized in numpy, so the hot loop lives here; the Python fallback in
+// runtime/native.py is bit-identical but ~100x slower.
+// ---------------------------------------------------------------------------
+
+static uint32_t g_crc32c_tbl[256];
+static std::once_flag g_crc32c_once;
+
+static void ds_crc32c_build_table() {
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k) c = (c & 1u) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+    g_crc32c_tbl[i] = c;
+  }
+}
+
+// Rolling API: pass the previous return value as `crc` to extend a running
+// checksum across chunks (start with 0); one-shot callers pass crc=0.
+uint32_t ds_crc32c(const uint8_t* data, uint64_t n, uint32_t crc) {
+  std::call_once(g_crc32c_once, ds_crc32c_build_table);
+  crc = ~crc;
+  for (uint64_t i = 0; i < n; ++i)
+    crc = g_crc32c_tbl[(crc ^ data[i]) & 0xffu] ^ (crc >> 8);
+  return ~crc;
+}
+
 }  // extern "C"

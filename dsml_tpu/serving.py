@@ -557,6 +557,25 @@ class ContinuousBatcher:
         self._insert = jax.jit(self._insert_fn, donate_argnums=(0,))
 
     @classmethod
+    def for_devices(cls, model, params, devices, **kwargs):
+        """Build a batcher whose replica SPANS ``devices``: more than one
+        device makes the replica tensor-parallel over a ``tp=len(devices)``
+        mesh (Megatron params, head-sharded cache — same tokens as the
+        single-device batcher); exactly one keeps the plain single-device
+        batcher. The ``DecodeFleet`` device-pool factory target: a fleet
+        handing each replica a slice of chips calls this, so replica
+        failover moves a MULTI-device replica's work just like a
+        single-device one's. ``len(devices)`` must divide the model's head
+        count (the tp-sharding rule)."""
+        devices = list(devices)
+        if len(devices) <= 1:
+            return cls(model, params, **kwargs)
+        from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        mesh = build_mesh(MeshSpec(tp=len(devices)), devices)
+        return cls(model, params, mesh=mesh, **kwargs)
+
+    @classmethod
     def from_checkpoint(cls, model, directory, step: int | None = None,
                         mesh=None, param_dtype=None, init_seed: int = 0, **kwargs):
         """Serve straight from a training checkpoint: a WEIGHTS-ONLY partial

@@ -19,12 +19,32 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import sys
 import typing
 from dataclasses import field as _dc_field
 from typing import Any, Sequence
 
-__all__ = ["Config", "field", "parse_cli", "ConfigError"]
+__all__ = ["Config", "field", "parse_cli", "ConfigError", "env_float", "env_int"]
+
+
+def env_float(name: str, default: float) -> float:
+    """``float(os.environ[name])`` with the default on unset/garbage — the
+    shared parser behind the ``DSML_*`` runtime knobs (stream TTL/stall,
+    migration deadlines); one implementation so a parsing fix cannot
+    diverge between subsystems."""
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer twin of :func:`env_float`."""
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
 
 
 def field(default=dataclasses.MISSING, *, default_factory=dataclasses.MISSING, help: str = ""):

@@ -36,6 +36,9 @@ SECTIONS = [
     ("cluster", 600),     # aggregation-plane overhead vs a REAL chip step,
     #                       merge/scrape/stitch micro-rows, regress gate
     #                       self-check + collective_profile.json
+    ("migration", 600),   # P2P shard-motion MB/s + recovery split (runs on
+    #                       the virtual-8 CPU mesh in a subprocess; the
+    #                       delivery/integrity verdicts are the signal)
     ("gpt2_decode", 1200),  # plain + wq8 + kv8 + kv4 variants, 2 compiles each
     ("allreduce", 600),   # incl. the e2e wire-path row (VERDICT r3 item 7)
     ("gpt2_seq8k", 900),
