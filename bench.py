@@ -1445,6 +1445,203 @@ def bench_bucket_sweep() -> dict:
         return {"bucket_sweep_error": repr(e)[:200]}
 
 
+def _quant_sweep_main() -> None:
+    """Subprocess entry: the (bucket size × quant scheme × algorithm) grid
+    on the 8-device virtual CPU mesh, plus the q8+EF loss-trajectory parity
+    leg the acceptance bar pins.
+
+    Grid cells reuse ``_bucket_sweep_main``'s differenced-repeats harness
+    (chain R syncs in one program, difference R_hi vs 1) over an 8 MiB
+    synthetic gradient tree. Every cell also reports its ANALYTIC per-rank
+    wire bytes (static shapes ⇒ exact): the ``*_wire_reduction`` rows are
+    quantized ÷ fp32 at equal bucket size — the ≥2× acceptance claim is a
+    counting argument, not a CPU-timing one (CPU ppermute latency carries
+    no ICI signal; the _ms cells are relative shape only, like the bucket
+    sweep). The parity leg trains the reference MNIST-shaped MLP
+    data-parallel on the virtual-8 mesh with fp32 ring vs q8_ring+EF vs
+    q8_ring (no EF) and reports per-step relative deviation against the
+    stated tolerance. ``DSML_QUANT_SWEEP_TINY=1`` shrinks the grid for the
+    CI smoke step."""
+    from dsml_tpu.utils.platform import configure_platform
+
+    configure_platform("cpu", 8)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from dsml_tpu.ops.collectives import ReduceOp, ring_wire_bytes
+    from dsml_tpu.ops.quantization import quantized_ring_wire_bytes
+    from dsml_tpu.parallel.bucketing import (
+        QUANT_RING_ALGORITHMS,
+        bucketed_all_reduce,
+        init_error_feedback,
+        plan_buckets,
+    )
+    from dsml_tpu.parallel.mesh import build_mesh, MeshSpec
+
+    tiny = os.environ.get("DSML_QUANT_SWEEP_TINY") == "1"
+    mesh = build_mesh(MeshSpec(dp=8), jax.devices()[:8])
+    # same payload shape as the bucket sweep: 256 KiB f32 leaves
+    n_leaves = 8 if tiny else 32
+    rng = np.random.default_rng(0)
+    tree = {
+        f"w{i:02d}": jnp.asarray(rng.standard_normal(65_536), jnp.float32)
+        for i in range(n_leaves)
+    }
+    total_elems = n_leaves * 65_536
+    total_bytes = total_elems * 4
+    r_hi, reps = (2, 2) if tiny else (3, 3)
+
+    def per_sync_ms(algorithm, bucket_mb):
+        def make(r):
+            def per_rank(t):
+                for _ in range(r):
+                    t = bucketed_all_reduce(t, "dp", ReduceOp.AVG, algorithm, bucket_mb)
+                return t
+
+            return jax.jit(jax.shard_map(
+                per_rank, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+            ))
+
+        def p50_of(r):
+            fn = make(r)
+            out = fn(tree)
+            float(out["w00"][0])  # compile + sync
+            ts = []
+            for _ in range(reps):
+                t0 = time.monotonic()
+                out = fn(out)
+                float(out["w00"][0])
+                ts.append((time.monotonic() - t0) * 1e3)
+            return float(np.percentile(ts, 50))
+
+        return max((p50_of(r_hi) - p50_of(1)) / (r_hi - 1), 0.0)
+
+    algorithms = (
+        ("ring", "q8_ring") if tiny
+        else ("ring", "ring2", "q8", "q8_ring", "q8_ring2", "q4_ring", "q4_ring2")
+    )
+    sizes = ((4, "4mb"),) if tiny else ((None, "1buf"), (1, "1mb"), (4, "4mb"))
+    rows: dict = {
+        "payload_mb": round(total_bytes / (1 << 20), 1),
+        "devices": 8,
+        "tiny_grid": tiny,
+    }
+    for algorithm in algorithms:
+        for bucket_mb, label in sizes:
+            n_buckets = (
+                1 if bucket_mb is None else plan_buckets(tree, bucket_mb).n_buckets
+            )
+            ms = per_sync_ms(algorithm, bucket_mb)
+            rows[f"{algorithm}_{label}_ms"] = round(ms, 3)
+            rows[f"{algorithm}_{label}_buckets"] = n_buckets
+
+    # analytic wire bytes at the 4 MiB bucket size (per-bucket elements =
+    # one 256 KiB leaf × 16 — every bucket is uniform here, so one bucket's
+    # ratio is the grid's): the ≥2× acceptance row
+    bucket_elems = total_elems // max(plan_buckets(tree, 4).n_buckets, 1)
+    fp32_ring = ring_wire_bytes(bucket_elems, 8)
+    for name, (scheme, bidir) in QUANT_RING_ALGORITHMS.items():
+        qbytes = quantized_ring_wire_bytes(bucket_elems, 8, scheme, bidir)
+        rows[f"{name}_wire_bytes_per_bucket"] = qbytes
+        rows[f"{scheme}_{'ring2' if bidir else 'ring'}_wire_reduction"] = round(
+            fp32_ring / qbytes, 2
+        )
+    rows["fp32_ring_wire_bytes_per_bucket"] = fp32_ring
+
+    # ---- loss-trajectory parity: fp32 ring vs q8_ring+EF (the acceptance
+    # leg), plus q8_ring no-EF and q8_ring2+EF on the full grid. int4 has
+    # no parity leg by design: its ~0.5-quantum noise visibly perturbs the
+    # trajectory (docs/TUNING.md states so) and a pass/fail row against the
+    # q8 tolerance would just be red
+    import optax
+
+    from dsml_tpu.models.mlp import MLP
+    from dsml_tpu.parallel.dp import make_dp_train_step
+    from dsml_tpu.utils.data import synthetic_classification
+
+    model = MLP(sizes=(64, 32, 4))
+    data = synthetic_classification(512, 64, classes=4, seed=0)
+    steps = 12 if tiny else 40
+    bx, by = data.train_x, data.train_y
+
+    def trajectory(algorithm, ef_on):
+        opt = optax.sgd(0.05, momentum=0.9)
+        step = make_dp_train_step(
+            model.loss, opt, mesh, algorithm=algorithm, bucket_size_mb=4,
+            error_feedback=ef_on,
+        )
+        params = model.init(0)
+        opt_state = opt.init(params)
+        ef = init_error_feedback(params, mesh, "dp") if ef_on else None
+        out = []
+        for s in range(steps):
+            lo = (s * 64) % (len(bx) - 64)
+            x, y = bx[lo:lo + 64], by[lo:lo + 64]
+            if ef_on:
+                params, opt_state, ef, loss = step(params, opt_state, ef, x, y)
+            else:
+                params, opt_state, loss = step(params, opt_state, x, y)
+            out.append(float(loss))
+        return out
+
+    ref = trajectory("ring", False)
+    tolerance = 0.05  # max per-step relative deviation vs the fp32 ring sync
+
+    def parity(tag, algorithm, ef_on):
+        got = trajectory(algorithm, ef_on)
+        rel_dev = max(
+            abs(a - b) / max(abs(b), 1e-3) for a, b in zip(got, ref)
+        )
+        rows[f"parity_{tag}_final_loss"] = round(got[-1], 6)
+        rows[f"parity_{tag}_rel_dev"] = round(rel_dev, 5)
+        rows[f"parity_{tag}_ok"] = rel_dev <= tolerance
+
+    rows["parity_fp32_final_loss"] = round(ref[-1], 6)
+    rows["parity_steps"] = steps
+    rows["parity_tolerance"] = tolerance
+    parity("q8_ef", "q8_ring", True)
+    if not tiny:
+        parity("q8_noef", "q8_ring", False)
+        parity("q8_ring2_ef", "q8_ring2", True)
+    print(json.dumps(rows))
+
+
+def bench_quant_sweep() -> dict:
+    """The block-quantized collective grid (virtual-8 mesh subprocess, same
+    pattern as :func:`bench_bucket_sweep`): per-sync ms + analytic wire
+    bytes across (bucket size × quant scheme × ring/ring2), the
+    ``*_wire_reduction`` rows the ≥2× acceptance bar reads, and the q8+EF
+    loss-trajectory parity verdicts. The numbers the ``DSML_QUANT``
+    per-dtype default is chosen from (docs/TUNING.md § Quantized
+    collectives)."""
+    code = "import bench; bench._quant_sweep_main()"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=max(min(900.0, _budget_left()), 60.0),
+        )
+        if proc.returncode != 0 or not proc.stdout.strip():
+            return {
+                "quant_sweep_error": (
+                    f"rc={proc.returncode}; stderr tail: {proc.stderr[-300:]}"
+                )
+            }
+        res = json.loads(proc.stdout.strip().splitlines()[-1])
+        out = {f"quant_sweep_{k}": v for k, v in res.items()}
+        out["quant_sweep_note"] = (
+            "8-device virtual CPU mesh: _ms cells are relative signal (not "
+            "ICI); wire_reduction rows are analytic byte counts; parity "
+            "rows are measured loss trajectories vs the fp32 ring sync"
+        )
+        return out
+    except Exception as e:  # never fail the bench on the secondary section
+        return {"quant_sweep_error": repr(e)[:200]}
+
+
 def bench_ring_virtual8() -> dict:
     """The same jitted ring program on an 8-device virtual CPU mesh — proof
     the 2(n−1)-hop harness measures a ring that actually hops (VERDICT r1
@@ -2828,6 +3025,8 @@ _SECTIONS = {
     "realtext": bench_gpt2_realtext,
     "serving": bench_serving,
     "bucket_sweep": bench_bucket_sweep,  # virtual-8 sweep; no TPU rows
+    "quant_sweep": bench_quant_sweep,  # virtual-8 quantized-collective grid
+    #                                    + q8+EF parity verdicts; no TPU rows
     "checkpoint": bench_checkpoint,
     "obs": bench_obs,
     "forensics": bench_forensics,
@@ -3155,6 +3354,15 @@ def main() -> None:
         except Exception as e:
             errors["bucket_sweep"] = repr(e)[:300]
         _bump_progress()
+    # block-quantized collective grid + q8+EF parity (virtual-8 subprocess):
+    # the data the DSML_QUANT per-dtype default is chosen from, budget-gated
+    # like the bucket sweep
+    if not _skip_for_budget(extras, "quant_sweep", 300):
+        try:
+            extras.update(bench_quant_sweep())
+        except Exception as e:
+            errors["quant_sweep"] = repr(e)[:300]
+        _bump_progress()
     _emit_final(extras, errors, no_tpu_signal, tpu_unreachable)
 
 
@@ -3287,6 +3495,11 @@ def _assemble_and_print(extras: dict, errors: dict, no_tpu_signal: bool,
         "bucket_sweep": (
             "8-device virtual CPU mesh — relative bucket-size signal for "
             "the DSML_BUCKET_MB default, not ICI"
+        ),
+        "quant_sweep": (
+            "8-device virtual CPU mesh — _ms cells relative signal only; "
+            "wire_reduction rows analytic byte counts; parity rows measured "
+            "loss trajectories vs the fp32 ring"
         ),
     }
 

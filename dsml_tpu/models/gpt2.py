@@ -969,14 +969,16 @@ class GPT2:
         a = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
         if self._kv_mode() == "int4":
             s = jnp.where(a > 0, a / 7.0, 1.0)
-            q = jnp.clip(jnp.round(x32 / s), -7, 7).astype(jnp.int32) + 8
             # channel HALVES pack contiguously (high nibbles = channels
             # [0, hd/2), low = [hd/2, hd)) so the unpack is a concat of two
             # shift/mask ops — fusion-friendly, no interleaving gather that
-            # would materialize a full-width cache copy per step
-            half = q.shape[-1] // 2
-            packed = (q[..., :half] << 4 | q[..., half:]).astype(jnp.uint8)
-            return packed, s
+            # would materialize a full-width cache copy per step. The
+            # layout is ops.quantization.pack_int4 — THE shared nibble
+            # format the int4 collective wire path uses too (bit-identity
+            # to the original inline packing pinned in tests).
+            from dsml_tpu.ops.quantization import pack_int4
+
+            return pack_int4(jnp.clip(jnp.round(x32 / s), -7, 7)), s
         s = jnp.where(a > 0, a / 127.0, 1.0)
         return jnp.round(x32 / s).astype(jnp.int8), s
 
@@ -997,11 +999,12 @@ class GPT2:
     @staticmethod
     def _unpack_int4(p):
         """[..., hd/2] packed nibbles → [..., hd] int8 in [-7, 7] (channel
-        halves are contiguous — see :meth:`_kv_quantize` — so this is a
-        concat of two elementwise ops, not an interleaving gather)."""
-        hi = (p >> 4).astype(jnp.int8) - 8
-        lo = (p & 0xF).astype(jnp.int8) - 8
-        return jnp.concatenate([hi, lo], axis=-1)
+        halves are contiguous — see :meth:`_kv_quantize`; the shared
+        ``ops.quantization.unpack_int4``, a concat of two elementwise ops,
+        not an interleaving gather)."""
+        from dsml_tpu.ops.quantization import unpack_int4
+
+        return unpack_int4(p)
 
     def _cache_attn_inputs(self, c: dict):
         """(ck, cv, k_s, v_s) for :meth:`_decode_attention` — scales are

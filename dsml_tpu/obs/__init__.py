@@ -96,7 +96,7 @@ __all__ = [
     "StepBreakdown", "GoodputTracker", "mfu", "STEP_PHASES",
     "MetricsLogger", "MetricsServer", "start_metrics_server",
     "record_collective_plan", "observe_collective_latency_ms",
-    "observe_recovery_ms",
+    "observe_recovery_ms", "record_quant_sync_bytes",
     "FlightRecorder", "get_flight_recorder", "dump_postmortem",
     "SentinelConfig", "SentinelTripped", "TrainingSentinels",
     "HangWatch", "TrailingDeadline", "get_hangwatch",
@@ -213,6 +213,31 @@ def record_collective_plan(algorithm: str, tree, bucket_size_mb,
             "collective_plan", algorithm=algorithm, axis=axis,
             buckets=n_buckets, bytes=int(sum(sizes)),
         )
+
+
+def record_quant_sync_bytes(bytes_by_scheme: dict, algorithm: str,
+                            axis: str = "dp",
+                            registry: Registry | None = None) -> None:
+    """One quantized gradient sync's wire bytes →
+    ``collective_quant_bytes_total{scheme,algorithm,axis}``.
+
+    ``bytes_by_scheme`` is the ANALYTIC per-sync byte count from
+    ``parallel.bucketing.plan_quant_wire_bytes`` (static shapes ⇒ exact).
+    The dp/zero2 frontends call this once per step from the host-side
+    dispatch wrapper — a dict walk and one no-op-able counter write, never
+    a device sync — so the counter is a true cumulative total, unlike the
+    trace-time plan gauges which record once per compile."""
+    reg = registry if registry is not None else get_registry()
+    if not reg.enabled or not bytes_by_scheme:
+        return
+    c = reg.counter(
+        "collective_quant_bytes_total",
+        "wire bytes shipped by quantized gradient syncs (analytic per-sync "
+        "count; fp32 rows are the uncompressed buckets riding along)",
+        labels=("scheme", "algorithm", "axis"),
+    )
+    for scheme, nbytes in bytes_by_scheme.items():
+        c.inc(nbytes, scheme=scheme, algorithm=algorithm, axis=axis)
 
 
 def observe_recovery_ms(stage: str, ms: float,

@@ -167,6 +167,12 @@ _NOT_A_METRIC = (
     "_bytes", "_mb", "_requests", "n_requests", "_quantum", "_window",
     "_events", "_count", "capture_", "_buckets", "_replicas", "timed_",
     "warmup_", "_remat",
+    # quant_sweep section: parity rows are correctness verdicts against a
+    # stated tolerance (never perf-gated — a "regression" there is a test
+    # failure, not a noise-band question), wire reductions and tolerances
+    # are analytic constants. The grid's quant `_ms` cells stay gated
+    # down-good via the `_ms` suffix rule below.
+    "parity", "_reduction", "_tolerance",
 )
 _HIGHER_BETTER = (
     "samples_per_sec", "tokens_per_sec", "tokens_per_s", "goodput",

@@ -52,6 +52,7 @@ __all__ = [
     "all_gather",
     "all_to_all",
     "ppermute_ring",
+    "ring_wire_bytes",
     "make_stacked_all_reduce",
     "device_buffers_all_reduce",
 ]
@@ -203,6 +204,24 @@ def naive_all_reduce(x: jax.Array, axis_name: str, op: ReduceOp = ReduceOp.SUM) 
     else:
         out = jnp.max(gathered, axis=0)
     return out.astype(x.dtype)
+
+
+def ring_wire_bytes(
+    n_elems: int, n_ranks: int, itemsize: int = 4, bidirectional: bool = False
+) -> int:
+    """Analytic per-rank wire bytes of one full-precision ring all-reduce:
+    2(n−1) hops × one segment of the (padded) payload each, at ``itemsize``
+    bytes per element. The bidirectional ring moves the same total volume
+    (two half-payloads, half the bytes per direction). The fp32 baseline
+    the quantized schedules' ``*_wire_reduction`` bench rows divide by
+    (their counterpart is ``ops.quantization.quantized_ring_wire_bytes``);
+    static shapes ⇒ exact, not sampled."""
+    if n_ranks <= 1:
+        return 0
+    k = 2 if bidirectional else 1
+    quantum = k * n_ranks
+    padded = -(-n_elems // quantum) * quantum
+    return 2 * (n_ranks - 1) * (padded // n_ranks) * itemsize
 
 
 def auto_all_reduce_algorithm(nbytes: int, n_devices: int, latency_bytes: int = 32768) -> str:

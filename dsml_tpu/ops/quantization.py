@@ -1,4 +1,4 @@
-"""Int8 quantization with stochastic rounding + compressed gradient sync.
+"""Block-scaled quantization + compressed collective schedules.
 
 The reference's memory/communication literature (ActNN/GACT activation
 compression, SURVEY.md §2.4 folder 7; gradient-compression systems in folder
@@ -9,21 +9,43 @@ compression, SURVEY.md §2.4 folder 7; gradient-compression systems in folder
   gradients don't bias SGD. On TPU the quantizer is a Pallas kernel using
   the on-core PRNG (``pltpu.prng_random_bits``) per the TPU kernel playbook;
   elsewhere an XLA path with ``jax.random`` does the same math.
-- :func:`compressed_all_reduce` — gradient sync at 8 bits/element: each rank
-  quantizes its contribution, int8 blocks + f32 scales all-gather (4×
-  fewer wire bytes than f32), every rank dequantizes and reduces locally.
-  Mean-preserving (AVG) by default, the DP gradient contract.
+- :func:`compressed_all_reduce` — the v1 compressed sync: each rank
+  quantizes its contribution, int8 blocks + f32 scales all-gather, every
+  rank dequantizes and reduces locally. O(n) wire bytes per rank — kept as
+  the latency-optimal small-payload shape and the A/B baseline.
+- :func:`quantized_ring_all_reduce` — the v2 schedule (EQuARX-style,
+  PAPERS.md): block-scaled int8 **or int4** quantization *inside* the
+  2(n−1)-step ring. Scatter-reduce hops quantize the outgoing chunk,
+  dequantize-accumulate at the receiver, re-quantize for the next hop; the
+  all-gather half circulates each owner's quantized representation
+  UNCHANGED (one quantization per reduced segment — no per-hop error
+  compounding, and every rank dequantizes the same bytes, so the
+  all-reduce postcondition holds bit-exactly across ranks). Bandwidth-
+  optimal volume at 8/4 bits per element instead of v1's
+  gather-everything; ``bidirectional=True`` is the full-duplex ring2.
+- :func:`quantized_flat_reduce_scatter` — the same quantized scatter-reduce
+  half standalone, with ``flat_reduce_scatter``'s rank-i-gets-segment-i
+  layout: the ZeRO-2 bucket sync primitive.
+- :func:`quantize_roundtrip` / error feedback — deterministic-rounding
+  compression round trip; ``parallel.bucketing`` folds the residual
+  ``x − roundtrip(x)`` into the next step's gradients so repeated
+  quantized syncs don't drift (EF-SGD).
 - :func:`compressed_checkpoint` — ActNN-style compressed rematerialization:
   ``jax.checkpoint`` whose stash is the int8-quantized input activation, so
   the per-layer residual footprint drops ~4× below even plain remat.
 
-``dsml_tpu.parallel.dp`` exposes the gradient path as ``algorithm="q8"``;
-``GPT2Config.remat = "int8"`` selects the activation path.
+``dsml_tpu.parallel.dp`` exposes the gradient paths as ``algorithm="q8"``
+(v1) and ``"q8_ring" / "q8_ring2" / "q4_ring" / "q4_ring2" / "quant"``
+(v2; ``"quant"`` resolves per dtype from ``DSML_QUANT`` — see
+:func:`quant_algorithm_for`). ``GPT2Config.remat = "int8"`` selects the
+activation path; the GPT-2 int4 KV cache shares :func:`pack_int4` /
+:func:`unpack_int4`.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -31,8 +53,18 @@ from jax import lax
 
 __all__ = [
     "QuantizedTensor",
+    "QuantScheme",
+    "get_scheme",
+    "default_qblock",
+    "quant_algorithm_for",
+    "pack_int4",
+    "unpack_int4",
     "quantize_int8",
     "dequantize_int8",
+    "quantize_roundtrip",
+    "quantized_ring_all_reduce",
+    "quantized_flat_reduce_scatter",
+    "quantized_ring_wire_bytes",
     "compressed_all_reduce",
     "compressed_checkpoint",
 ]
@@ -142,6 +174,416 @@ def quantize_int8(x: jax.Array, seed: jax.Array | int = 0, use_pallas: bool | No
 def dequantize_int8(qt: QuantizedTensor) -> jax.Array:
     flat = (qt.values.astype(jnp.float32) * qt.scales).reshape(-1)[: qt.size]
     return flat.reshape(qt.shape).astype(qt.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Quant schemes (int8 / int4), env knobs, shared nibble packing
+# ---------------------------------------------------------------------------
+
+_SCHEME_TABLE = {"int8": (8, 127), "int4": (4, 7)}
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantScheme:
+    """Static description of one block-scaled integer format: ``bits`` on
+    the wire per element, symmetric range ``[-qmax, qmax]``, one f32 scale
+    per ``block`` elements. int4 packs two values per byte
+    (:func:`pack_int4`), so its block must be even."""
+
+    name: str  # "int8" | "int4"
+    bits: int
+    qmax: int
+    block: int
+
+    @property
+    def wire_bytes_per_block(self) -> int:
+        """Bytes one quantized block occupies on the wire: packed values
+        plus its f32 scale."""
+        return self.block * self.bits // 8 + 4
+
+
+def default_qblock() -> int:
+    """Elements per scale block: 512 (the v1 ``quantize_int8`` block, kept —
+    docs/TUNING.md), overridable via ``DSML_QBLOCK``. Malformed, non-positive
+    or odd values fall back (odd blocks would split an int4 nibble pair)."""
+    try:
+        b = int(os.environ.get("DSML_QBLOCK", _BLOCK))
+    except ValueError:
+        return _BLOCK
+    return b if b > 0 and b % 2 == 0 else _BLOCK
+
+
+def get_scheme(name: str, block: int | None = None) -> QuantScheme:
+    """Resolve ``"int8"``/``"int4"`` (or a :class:`QuantScheme`, returned
+    as-is) to a scheme with ``block`` elements per scale (default:
+    :func:`default_qblock`)."""
+    if isinstance(name, QuantScheme):
+        return name
+    if name not in _SCHEME_TABLE:
+        raise ValueError(
+            f"unknown quant scheme {name!r}; choose from {sorted(_SCHEME_TABLE)}"
+        )
+    bits, qmax = _SCHEME_TABLE[name]
+    block = default_qblock() if block is None else int(block)
+    if block <= 0 or block % 2:
+        raise ValueError(f"quant block must be positive and even, got {block}")
+    return QuantScheme(name, bits, qmax, block)
+
+
+_ALGO_FOR_SCHEME = {
+    ("int8", "ring"): "q8_ring",
+    ("int8", "ring2"): "q8_ring2",
+    ("int4", "ring"): "q4_ring",
+    ("int4", "ring2"): "q4_ring2",
+}
+# the sweep-chosen default (docs/TUNING.md § Quantized collectives): int8
+# keeps the loss trajectory within tolerance without error feedback being
+# mandatory, ring2 rides full-duplex ICI at half the per-direction payload
+_DEFAULT_QUANT = "int8:ring2"
+
+
+def quant_algorithm_for(dtype) -> str:
+    """The ``DSML_QUANT`` env knob: which quantized sync a given gradient
+    dtype should use when the caller says ``algorithm="quant"``.
+
+    Grammar: ``SCHEME[:ALGO]`` applied to every float dtype, or a per-dtype
+    comma list ``float32=int8:ring2,bfloat16=int4:ring2`` (unlisted dtypes
+    fall back to the ``default=`` entry, else the built-in default).
+    SCHEME ∈ {int8, int4, none}; ALGO ∈ {ring, ring2} (default ring2).
+    ``none`` means sync that dtype unquantized (the fp32 ring). Malformed
+    values fall back to the default rather than failing a training step.
+    """
+    key = str(jnp.dtype(dtype)) if not isinstance(dtype, str) else dtype
+    raw = os.environ.get("DSML_QUANT", "").strip() or _DEFAULT_QUANT
+    chosen = None
+    if "=" in raw:
+        table = {}
+        for item in raw.split(","):
+            if "=" in item:
+                k, _, v = item.partition("=")
+                table[k.strip()] = v.strip()
+        chosen = table.get(key, table.get("default"))
+    else:
+        chosen = raw
+    if not chosen:
+        chosen = _DEFAULT_QUANT
+    scheme, _, algo = chosen.partition(":")
+    scheme, algo = scheme.strip(), (algo.strip() or "ring2")
+    if scheme == "none":
+        return algo if algo in ("ring", "ring2") else "ring"
+    if scheme not in _SCHEME_TABLE or algo not in ("ring", "ring2"):
+        scheme, _, algo = _DEFAULT_QUANT.partition(":")
+    return _ALGO_FOR_SCHEME[(scheme, algo)]
+
+
+def pack_int4(q: jax.Array) -> jax.Array:
+    """Pack int values in ``[-7, 7]`` two-per-byte along the last axis
+    (must be even): offset to ``q+8`` ∈ [1, 15], contiguous HALVES — the
+    first half of the axis rides the high nibbles, the second half the low
+    — so the unpack is a concat of two shift/mask ops, never an
+    interleaving gather. This is THE nibble layout: the GPT-2 int4 KV
+    cache and the int4 collective wire format both use it (bit-identity
+    to the original KV-cache packing pinned in tests)."""
+    if q.shape[-1] % 2:
+        raise ValueError(f"pack_int4 needs an even last axis, got {q.shape}")
+    q = q.astype(jnp.int32) + 8
+    half = q.shape[-1] // 2
+    return (q[..., :half] << 4 | q[..., half:]).astype(jnp.uint8)
+
+
+def unpack_int4(p: jax.Array) -> jax.Array:
+    """Inverse of :func:`pack_int4`: ``[..., k]`` packed bytes →
+    ``[..., 2k]`` int8 in ``[-7, 7]`` (channel halves contiguous)."""
+    hi = (p >> 4).astype(jnp.int8) - 8
+    lo = (p & 0xF).astype(jnp.int8) - 8
+    return jnp.concatenate([hi, lo], axis=-1)
+
+
+def _block_quant(blocks: jax.Array, scheme: QuantScheme, seed=None):
+    """Blockwise absmax quantization of ``[rows, block]`` f32. ``seed=None``
+    = deterministic round-to-nearest (the error-feedback pairing: the
+    residual exactly accounts the committed rounding); a seed = stochastic
+    ``floor(y + u)`` (unbiased — the no-EF pairing, where zero-mean noise
+    is what prevents step-correlated bias)."""
+    scales = jnp.maximum(
+        jnp.max(jnp.abs(blocks), axis=-1, keepdims=True) / scheme.qmax, 1e-12
+    )
+    y = blocks / scales
+    if seed is None:
+        q = jnp.round(y)
+    else:
+        u = jax.random.uniform(
+            jax.random.PRNGKey(jnp.asarray(seed, jnp.int32)), blocks.shape, jnp.float32
+        )
+        q = jnp.floor(y + u)
+    return jnp.clip(q, -scheme.qmax, scheme.qmax).astype(jnp.int8), scales
+
+
+def _to_wire(q: jax.Array, scheme: QuantScheme) -> jax.Array:
+    return pack_int4(q) if scheme.bits == 4 else q
+
+
+def _from_wire(w: jax.Array, scheme: QuantScheme) -> jax.Array:
+    return unpack_int4(w) if scheme.bits == 4 else w
+
+
+def quantize_roundtrip(flat: jax.Array, scheme="int8") -> jax.Array:
+    """``dequantize(quantize(flat))`` under deterministic rounding — the
+    local compression a rank commits when it first ships ``flat``. The
+    error-feedback residual is ``flat − quantize_roundtrip(flat)``: the
+    dominant, locally-attributable term of the ring's compression error
+    (later hops re-quantize *mixed* partial sums, which no single rank can
+    account — the residual is a first-order correction, and the bench
+    parity rows are what pin that it suffices)."""
+    sch = get_scheme(scheme)
+    flat = flat.astype(jnp.float32).reshape(-1)
+    size = flat.shape[0]
+    padded = -(-size // sch.block) * sch.block
+    if padded != size:
+        flat = jnp.pad(flat, (0, padded - size))
+    q, scales = _block_quant(flat.reshape(-1, sch.block), sch)
+    return (q.astype(jnp.float32) * scales).reshape(-1)[:size]
+
+
+def quantized_ring_wire_bytes(
+    n_elems: int, n_ranks: int, scheme="int8", bidirectional: bool = False
+) -> int:
+    """Analytic per-rank wire bytes of one quantized ring all-reduce:
+    2(n−1) hops, each shipping one padded segment's packed values + f32
+    block scales. The counterpart fp32 number is
+    ``ops.collectives.ring_wire_bytes`` — their ratio is the bench grid's
+    ``*_wire_reduction`` row (static shapes ⇒ exact, not sampled)."""
+    sch = get_scheme(scheme)
+    if n_ranks <= 1:
+        return 0
+    k = 2 if bidirectional else 1
+    quantum = k * n_ranks * sch.block
+    padded = -(-n_elems // quantum) * quantum
+    blocks_per_seg = padded // (k * n_ranks) // sch.block
+    per_hop = blocks_per_seg * sch.wire_bytes_per_block
+    return k * 2 * (n_ranks - 1) * per_hop
+
+
+def compressed_gather_wire_bytes(n_elems: int, n_ranks: int) -> int:
+    """Analytic per-rank wire bytes of the v1 ``compressed_all_reduce``
+    gather exchange: every rank receives the other n−1 ranks' full int8
+    payload + f32 block scales — O(n) per rank, the wire-byte shape the
+    ring schedules exist to beat."""
+    if n_ranks <= 1:
+        return 0
+    blocks = -(-n_elems // _BLOCK)
+    return (n_ranks - 1) * (blocks * _BLOCK + blocks * 4)
+
+
+def _ring_perms(n: int) -> dict:
+    return {
+        +1: [(i, (i + 1) % n) for i in range(n)],
+        -1: [(i, (i - 1) % n) for i in range(n)],
+    }
+
+
+def _dither_seed(blocks: jax.Array, base, rank, salt: int) -> jax.Array:
+    """Stochastic-rounding seed for one hop's chunk: the chunk's own bits
+    (varies per step with the data) mixed with the caller seed, rank, and
+    hop salt so no two ranks/hops share a dither pattern. ONE definition —
+    the all-reduce and reduce-scatter schedules must never drift apart."""
+    return (
+        jnp.sum(lax.bitcast_convert_type(blocks, jnp.int32), dtype=jnp.int32)
+        + base
+        + rank * jnp.int32(7919)
+        + jnp.int32(salt)
+    )
+
+
+def _quant_chunk_wire(blocks, scheme: QuantScheme, stochastic, base, rank, salt):
+    """``[rows, block]`` f32 → (wire values, scales): the one quantize-
+    for-the-wire step both ring schedules ship each hop through."""
+    if stochastic:
+        q, sc = _block_quant(blocks, scheme, seed=_dither_seed(blocks, base, rank, salt))
+    else:
+        q, sc = _block_quant(blocks, scheme)
+    return _to_wire(q, scheme), sc
+
+
+def _dequant_wire(wire, sc, scheme: QuantScheme) -> jax.Array:
+    """Inverse of :func:`_quant_chunk_wire`, flattened to 1-D."""
+    return (_from_wire(wire, scheme).astype(jnp.float32) * sc).reshape(-1)
+
+
+def quantized_ring_all_reduce(
+    x: jax.Array,
+    axis_name: str,
+    scheme="int8",
+    bidirectional: bool = False,
+    mean: bool = True,
+    stochastic: bool = True,
+    seed: jax.Array | int = 0,
+) -> jax.Array:
+    """Block-scaled quantized ring all-reduce (SUM/AVG), inside
+    ``shard_map``.
+
+    The 2(n−1)-step ring schedule of ``ops.collectives`` with quantization
+    *inside* it (EQuARX, PAPERS.md): every scatter-reduce hop quantizes its
+    outgoing chunk to ``scheme`` (int8 or packed int4 + one f32 scale per
+    block), the receiver dequantizes and accumulates in f32, and the next
+    hop re-quantizes the partial sum. The all-gather half quantizes each
+    fully-reduced segment ONCE (by its owner) and circulates the wire
+    representation unchanged — no per-hop error compounding, and since
+    every rank dequantizes the owner's exact bytes the result is
+    bit-identical across ranks (the all-reduce postcondition, pinned in
+    tests). ``bidirectional=True`` splits the payload into two halves
+    running opposite directions (the ring2 full-duplex shape).
+
+    Wire bytes: ~2(n−1)/n · ``bits``/8 per element (+4/block for scales)
+    vs the fp32 ring's 2(n−1)/n · 4 — ≈4× (int8) / ≈8× (int4) fewer.
+
+    ``stochastic=True`` (default) dithers each hop's rounding with a seed
+    folded from the chunk's own bits + rank + hop, so slowly-moving
+    coordinates don't see the same rounding direction every step;
+    ``stochastic=False`` is deterministic round-to-nearest — the ERROR
+    FEEDBACK pairing (the residual then accounts the committed error
+    exactly, and resume is trivially bit-reproducible).
+
+    Zero-padding up to a multiple of ``directions·n·block`` keeps hop
+    boundaries block-aligned: pad lanes quantize to exactly 0 (absmax
+    scaling maps 0 → 0 under both roundings), only ever combine with other
+    ranks' pad lanes, and are sliced off before return — the no-leak
+    property the odd-tail regression test pins."""
+    sch = get_scheme(scheme)
+    if not jnp.issubdtype(jnp.result_type(x), jnp.floating):
+        raise ValueError(
+            f"quantized ring all-reduce needs a float input, got {jnp.result_type(x)}"
+        )
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    orig_shape, orig_dtype = x.shape, x.dtype
+    signs = (+1, -1) if bidirectional else (+1,)
+    k = len(signs)
+    flat = x.astype(jnp.float32).reshape(-1)
+    size = flat.shape[0]
+    quantum = k * n * sch.block
+    padded = -(-size // quantum) * quantum
+    if padded != size:
+        flat = jnp.pad(flat, (0, padded - size))
+    part = padded // k
+    seg = part // n
+    rows = seg // sch.block
+    rank = lax.axis_index(axis_name)
+    perms = _ring_perms(n)
+    base = jnp.asarray(seed, jnp.int32) * jnp.int32(1_000_003)
+
+    def q_chunk(chunk, salt):
+        # data-dependent dither, decorrelated across ranks AND hops
+        return _quant_chunk_wire(
+            chunk.reshape(rows, sch.block), sch, stochastic, base, rank, salt
+        )
+
+    def dq(wire, sc):
+        return _dequant_wire(wire, sc, sch)
+
+    parts = []
+    for d, s in enumerate(signs):
+        buf = flat[d * part : (d + 1) * part].reshape(n, seg)
+        # Scatter-reduce: quantize → ship → dequantize-accumulate, per hop.
+        for step in range(n - 1):
+            send_idx = (rank - s * step) % n
+            recv_idx = (rank - s * (step + 1)) % n
+            chunk = lax.dynamic_index_in_dim(buf, send_idx, 0, keepdims=False)
+            wire, sc = q_chunk(chunk, salt=2 * step + (s < 0))
+            wire = lax.ppermute(wire, axis_name, perms[s])
+            sc = lax.ppermute(sc, axis_name, perms[s])
+            resident = lax.dynamic_index_in_dim(buf, recv_idx, 0, keepdims=False)
+            buf = lax.dynamic_update_index_in_dim(
+                buf, resident + dq(wire, sc), recv_idx, 0
+            )
+        # All-gather: the owner quantizes its reduced segment ONCE; hops
+        # forward the received wire bytes untouched, so segment i is the
+        # same dequantization everywhere (incl. on the owner itself, which
+        # replaces its f32 copy with its own round trip).
+        own_idx = (rank + s) % n
+        carry_w, carry_s = q_chunk(
+            lax.dynamic_index_in_dim(buf, own_idx, 0, keepdims=False),
+            salt=1_000 + (s < 0),
+        )
+        out = lax.dynamic_update_index_in_dim(buf, dq(carry_w, carry_s), own_idx, 0)
+        for step in range(n - 1):
+            carry_w = lax.ppermute(carry_w, axis_name, perms[s])
+            carry_s = lax.ppermute(carry_s, axis_name, perms[s])
+            recv_idx = (rank - s * step) % n
+            out = lax.dynamic_update_index_in_dim(out, dq(carry_w, carry_s), recv_idx, 0)
+        parts.append(out.reshape(-1))
+    full = parts[0] if k == 1 else jnp.concatenate(parts)
+    full = full[:size]
+    if mean:
+        full = full / n
+    return full.reshape(orig_shape).astype(orig_dtype)
+
+
+def quantized_flat_reduce_scatter(
+    flat: jax.Array,
+    axis_name: str,
+    scheme="int8",
+    mean: bool = True,
+    stochastic: bool = True,
+    seed: jax.Array | int = 0,
+) -> tuple[jax.Array, int]:
+    """Quantized ring reduce-scatter of a flat vector: the scatter-reduce
+    half of :func:`quantized_ring_all_reduce` alone, with
+    ``ops.collectives.flat_reduce_scatter``'s layout contract — rank i is
+    left with contiguous segment i of the (mean) reduction, f32, and
+    ``padded`` is the length rounded up to a multiple of the axis size
+    (NOT of the block: segments block-pad per hop internally, so the shard
+    length matches the unquantized path's and ZeRO-2's sharded optimizer
+    state keeps its exact shapes). The ZeRO-2 bucket primitive: (n−1) hops
+    at ``bits``/8 bytes per element instead of fp32."""
+    sch = get_scheme(scheme)
+    if not jnp.issubdtype(jnp.result_type(flat), jnp.floating):
+        raise ValueError(
+            f"quantized reduce-scatter needs a float input, got {jnp.result_type(flat)}"
+        )
+    n = lax.axis_size(axis_name)
+    x = flat.astype(jnp.float32).reshape(-1)
+    size = x.shape[0]
+    padded = -(-size // n) * n
+    if padded != size:
+        x = jnp.pad(x, (0, padded - size))
+    if n == 1:
+        return x, padded
+    seg = padded // n
+    rows = -(-seg // sch.block)
+    blockpad = rows * sch.block - seg
+    buf = x.reshape(n, seg)
+    rank = lax.axis_index(axis_name)
+    perm = _ring_perms(n)[+1]
+    base = jnp.asarray(seed, jnp.int32) * jnp.int32(1_000_003)
+    # virtual rank r−1 runs the forward schedule, so ownership lands on
+    # segment (vr+1) = r — flat_reduce_scatter's rank-i-gets-segment-i rule
+    vr = (rank - 1) % n
+
+    def q_chunk(chunk, salt):
+        if blockpad:
+            chunk = jnp.pad(chunk, (0, blockpad))
+        return _quant_chunk_wire(
+            chunk.reshape(rows, sch.block), sch, stochastic, base, rank, salt
+        )
+
+    def dq(wire, sc):
+        return _dequant_wire(wire, sc, sch)[:seg]
+
+    for step in range(n - 1):
+        send_idx = (vr - step) % n
+        recv_idx = (vr - step - 1) % n
+        chunk = lax.dynamic_index_in_dim(buf, send_idx, 0, keepdims=False)
+        wire, sc = q_chunk(chunk, salt=step)
+        wire = lax.ppermute(wire, axis_name, perm)
+        sc = lax.ppermute(sc, axis_name, perm)
+        resident = lax.dynamic_index_in_dim(buf, recv_idx, 0, keepdims=False)
+        buf = lax.dynamic_update_index_in_dim(buf, resident + dq(wire, sc), recv_idx, 0)
+    shard = lax.dynamic_index_in_dim(buf, rank, 0, keepdims=False)
+    if mean:
+        shard = shard / n
+    return shard, padded
 
 
 def compressed_all_reduce(

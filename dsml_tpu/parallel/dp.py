@@ -15,17 +15,30 @@ batch is sharded across the ``dp`` mesh axis and gradients genuinely sync:
   ring AllReduce"). ``"ring2"`` is the bidirectional variant, ``"auto"``
   picks ring-vs-naive per payload.
 - ``algorithm="naive"`` — gather-everything baseline, for benchmarks.
-- ``algorithm="q8"``   — 8-bit compressed sync: per-rank gradients quantize
-  to blockwise int8 with stochastic rounding before the exchange (≈4× fewer
-  wire bytes; unbiased — ``dsml_tpu.ops.quantization``).
+- ``algorithm="q8"``   — v1 8-bit compressed sync: per-rank gradients
+  quantize to blockwise int8 with stochastic rounding, then ALL-GATHER
+  (O(n) wire bytes per rank; unbiased — ``dsml_tpu.ops.quantization``).
+- ``algorithm="q8_ring" / "q8_ring2" / "q4_ring" / "q4_ring2"`` — v2
+  block-quantized ring schedules (EQuARX-style): int8/int4 quantization
+  INSIDE the 2(n−1)-step ring — quantize each scatter-reduce hop's chunk,
+  dequantize-accumulate, re-quantize for the next hop; bandwidth-optimal
+  volume at 8/4 bits per element. ``"quant"`` picks the scheme per
+  gradient dtype from ``DSML_QUANT``.
+- ``error_feedback=True`` (quantized ring algorithms only): per-leaf
+  per-rank residual buffers fold the compression error into the next
+  step's gradients (EF-SGD), so repeated quantized syncs don't drift. The
+  step then carries the residual tree as explicit state —
+  ``step(params, opt_state, ef, x, y) -> (params, opt_state, ef, loss)``
+  — initialized by ``parallel.bucketing.init_error_feedback`` and
+  checkpointable like params (the trainer rides it in the manifest).
 
 Every explicit algorithm syncs through ``parallel.bucketing``: the gradient
 pytree partitions into ~``bucket_size_mb``-MiB buckets and each bucket's
 reduction is an INDEPENDENT collective inside the jitted step, so XLA's
 latency-hiding scheduler can overlap early buckets' exchange with the rest
-of the backward (and q8 quantizes per bucket instead of serializing one
-full-vector ravel→quantize). ``bucket_size_mb=None`` restores the old
-single-buffer sync bit-for-bit, for A/B measurement.
+of the backward (and quantized syncs quantize per bucket instead of
+serializing one full-vector ravel→quantize). ``bucket_size_mb=None``
+restores the old single-buffer sync bit-for-bit, for A/B measurement.
 """
 
 from __future__ import annotations
@@ -37,9 +50,20 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dsml_tpu.obs import flight_recorder, record_collective_plan
+from dsml_tpu.obs import (
+    flight_recorder,
+    record_collective_plan,
+    record_quant_sync_bytes,
+)
 from dsml_tpu.ops.collectives import ReduceOp
-from dsml_tpu.parallel.bucketing import bucketed_all_reduce, default_bucket_mb
+from dsml_tpu.parallel.bucketing import (
+    bucketed_all_reduce,
+    default_bucket_mb,
+    is_quantized_algorithm,
+    plan_buckets,
+    plan_quant_wire_bytes,
+    supports_error_feedback,
+)
 
 __all__ = ["make_dp_train_step", "make_eval_step"]
 
@@ -52,6 +76,7 @@ def make_dp_train_step(
     axis: str = "dp",
     donate: bool = True,
     bucket_size_mb: float | None | str = "auto",
+    error_feedback: bool = False,
 ):
     """Build ``step(params, opt_state, x, y) -> (params, opt_state, loss)``.
 
@@ -62,25 +87,83 @@ def make_dp_train_step(
     ``bucket_size_mb`` (explicit algorithms only): ``"auto"`` = the
     ``DSML_BUCKET_MB`` env default (4 MiB — docs/TUNING.md), a number = that
     many MiB per bucket, ``None`` = the pre-bucketing single-buffer sync.
+
+    ``error_feedback=True`` (quantized ring algorithms only) changes the
+    signature to ``step(params, opt_state, ef, x, y) -> (params, opt_state,
+    ef, loss)`` with ``ef`` the per-rank residual state from
+    ``parallel.bucketing.init_error_feedback(params, mesh, axis)`` —
+    sharded over ``axis`` (each device stores only its own residual) and
+    donated like the optimizer state.
     """
     repl = NamedSharding(mesh, P())
     batch_sh = NamedSharding(mesh, P(axis))
     if bucket_size_mb == "auto":
         bucket_size_mb = default_bucket_mb()
+    if error_feedback and not supports_error_feedback(algorithm):
+        raise ValueError(
+            f"error_feedback=True requires a quantized ring algorithm "
+            f"(q8_ring/q8_ring2/q4_ring/q4_ring2/quant), got {algorithm!r}"
+        )
     # build-time breadcrumb: a postmortem names the sync configuration the
     # dying run was built with, even before the first compile records a plan
     flight_recorder.record(
         "train_step_build", algorithm=algorithm, axis=axis,
         bucket_mb=bucket_size_mb, devices=mesh.devices.size,
+        error_feedback=error_feedback,
     )
     # Loss-reactive transforms (adaptive_plateau) consume the loss via
     # ``value=``; the wrapper lets every optimizer accept the extra arg.
     optimizer = optax.with_extra_args_support(optimizer)
+    n_ranks = mesh.shape[axis]
+    # filled at trace time (static shapes); read by the per-step dispatch
+    # wrapper below to bump the cumulative wire-byte counter
+    quant_bytes_cell: dict = {}
+
+    def _note_quant_bytes(grads):
+        if is_quantized_algorithm(algorithm) and not quant_bytes_cell:
+            plan = plan_buckets(
+                grads,
+                bucket_size_mb if bucket_size_mb is not None else float("inf"),
+            )
+            quant_bytes_cell.update(plan_quant_wire_bytes(plan, n_ranks, algorithm))
 
     if algorithm == "xla":
 
         def compute_grads(params, x, y):
             return jax.value_and_grad(loss_fn)(params, x, y)
+
+    elif error_feedback:
+
+        def compute_grads(params, ef, x, y):
+            def shard_fn(params, ef, x, y):
+                loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+                # EF syncs are plan-shaped even at None (per-dtype buckets,
+                # the zero2 convention) — resolve so the recorder models
+                # what actually runs, per its documented contract
+                record_collective_plan(
+                    algorithm, grads,
+                    bucket_size_mb if bucket_size_mb is not None else float("inf"),
+                    axis,
+                )
+                _note_quant_bytes(grads)
+                ef_local = jax.tree.map(lambda l: l[0], ef)
+                grads, new_ef = bucketed_all_reduce(
+                    grads, axis, ReduceOp.AVG, algorithm, bucket_size_mb,
+                    error_feedback=ef_local,
+                )
+                return (
+                    jax.lax.pmean(loss, axis),
+                    grads,
+                    jax.tree.map(lambda l: l[None], new_ef),
+                )
+
+            return jax.shard_map(
+                shard_fn,
+                mesh=mesh,
+                in_specs=(P(), P(axis), P(axis), P(axis)),
+                out_specs=(P(), P(), P(axis)),
+                check_vma=False,
+            )(params, ef, x, y)
 
     else:
 
@@ -90,6 +173,7 @@ def make_dp_train_step(
                 # trace-time (static shapes): records bucket count/bytes
                 # once per compile, labeled by algorithm — zero cost per step
                 record_collective_plan(algorithm, grads, bucket_size_mb, axis)
+                _note_quant_bytes(grads)
                 grads = bucketed_all_reduce(
                     grads, axis, ReduceOp.AVG, algorithm, bucket_size_mb
                 )
@@ -103,18 +187,48 @@ def make_dp_train_step(
                 check_vma=False,
             )(params, x, y)
 
-    def step(params, opt_state, x, y):
-        loss, grads = compute_grads(params, x, y)
-        updates, opt_state = optimizer.update(grads, opt_state, params, value=loss)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+    ef_sh = NamedSharding(mesh, P(axis))
 
-    return jax.jit(
-        step,
-        in_shardings=(repl, repl, batch_sh, batch_sh),
-        out_shardings=(repl, repl, repl),
-        donate_argnums=(0, 1) if donate else (),
-    )
+    if error_feedback:
+
+        def step(params, opt_state, ef, x, y):
+            loss, grads, ef = compute_grads(params, ef, x, y)
+            updates, opt_state = optimizer.update(grads, opt_state, params, value=loss)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, ef, loss
+
+        jitted = jax.jit(
+            step,
+            in_shardings=(repl, repl, ef_sh, batch_sh, batch_sh),
+            out_shardings=(repl, repl, ef_sh, repl),
+            donate_argnums=(0, 1, 2) if donate else (),
+        )
+    else:
+
+        def step(params, opt_state, x, y):
+            loss, grads = compute_grads(params, x, y)
+            updates, opt_state = optimizer.update(grads, opt_state, params, value=loss)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        jitted = jax.jit(
+            step,
+            in_shardings=(repl, repl, batch_sh, batch_sh),
+            out_shardings=(repl, repl, repl),
+            donate_argnums=(0, 1) if donate else (),
+        )
+
+    if not is_quantized_algorithm(algorithm):
+        return jitted
+
+    def run(*args):
+        out = jitted(*args)
+        # first call traced above, so the cell is filled by now; one dict
+        # walk + a no-op-able counter write per step (obs discipline)
+        record_quant_sync_bytes(quant_bytes_cell, algorithm, axis)
+        return out
+
+    return run
 
 
 def make_eval_step(model, mesh: Mesh, axis: str = "dp"):

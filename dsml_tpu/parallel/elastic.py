@@ -46,6 +46,7 @@ __all__ = [
     "ElasticPolicy",
     "check_recoverable",
     "reconfigure",
+    "remap_error_feedback",
     "reshard_onto",
     "restore_from_checkpoint",
     "ElasticState",
@@ -82,6 +83,10 @@ class ElasticState:
     # reconfigurations) — the supervision loop rewinds its step counter to
     # exactly this and replays, which is how "lost work" becomes a number
     step: int | None = None
+    # error-feedback residual state remapped onto the new width (None when
+    # the run doesn't use quantized sync with EF) — see
+    # :func:`remap_error_feedback`
+    error_feedback: object = None
 
 
 def _leaf_shardings(tree):
@@ -140,6 +145,44 @@ def check_recoverable(state, lost_devices) -> list[str]:
     return [descr for _, descr in _torn_leaves(state, lost_devices)]
 
 
+def remap_error_feedback(ef, new_mesh, axis: str = "dp", lost_devices=()):
+    """Carry error-feedback residual mass across a width change.
+
+    EF residuals (``parallel.bucketing.init_error_feedback``) are PER-RANK
+    state — leaf shape ``[old_n, *grad_shape]``, sharded over ``axis``, and
+    a rank's row exists only on that rank's device. A width change makes
+    per-rank identity meaningless, but the residuals' TOTAL effect on the
+    synced mean gradient is well defined: under AVG each rank's residual
+    enters as ``r_i / n``, so the standing uncommitted mass is
+    ``Σ r_i / old_n``. This remap gives every new rank
+    ``Σ_surviving r_i / old_n`` — then ``new_sum / new_n = Σ r_i / old_n``
+    and the next sync injects exactly the mass the compressor still owed,
+    at any new width. Residual rows whose device died are GONE (their
+    uncommitted gradient mass is lost, like the dead rank's local
+    gradients themselves would be) and drop out of the sum — deterministic
+    and honest, the same policy as the torn-state zero-fill.
+    """
+    new_n = new_mesh.shape[axis]
+    lost = {getattr(d, "id", d) for d in lost_devices}
+    sharding = NamedSharding(new_mesh, P(axis))
+
+    def remap(leaf):
+        old_n = leaf.shape[0]
+        total = np.zeros(leaf.shape[1:], np.float32)
+        seen = set()  # replicas (multi-axis meshes) must count once
+        for shard in leaf.addressable_shards:
+            key = _piece_key(shard.index, leaf.shape)
+            if shard.device.id in lost or key in seen:
+                continue
+            seen.add(key)
+            total += np.asarray(shard.data, np.float32).sum(axis=0)
+        row = total / old_n
+        host = np.broadcast_to(row, (new_n, *row.shape)).copy()
+        return jax.device_put(jnp.asarray(host, jnp.float32), sharding)
+
+    return jax.tree.map(remap, ef)
+
+
 def _plan_for_survivors(
     model, n_params: int, survivors: list, batch_per_device: int,
     global_batch: int | None, planner_overrides: dict | None,
@@ -192,6 +235,8 @@ def reconfigure(
     planner_overrides: dict | None = None,
     migrator=None,
     non_addressable=(),
+    error_feedback=None,
+    ef_axis: str = "dp",
 ) -> ElasticState:
     """Continue training on the survivor fleet.
 
@@ -209,6 +254,12 @@ def reconfigure(
        (measured ``hbm_bytes``/``act_bytes``, budget fractions) so the
        re-plan uses the same hardware facts the original plan did.
     3. Pull state to host once and re-shard onto the new mesh.
+
+    ``error_feedback`` (a quantized-sync run's residual state) is remapped
+    onto the new ``ef_axis`` width via :func:`remap_error_feedback` —
+    surviving ranks' uncommitted compression error re-enters the first
+    post-recovery sync at the same injected mass; dead ranks' residuals
+    are lost like their local gradients.
 
     Returns :class:`ElasticState` with the new (params, opt_state, mesh);
     the caller rebuilds its step function with
@@ -242,8 +293,14 @@ def reconfigure(
 
     cfg = getattr(model, "config", None)
     old_pp = _detect_stacked_pp(params)
+    # GPT2-family models expose n_params(params); the small dp models
+    # (MLP/CNN) carry it as a plain attribute — accept both so a
+    # data-parallel run can ride the same recovery path
+    n_params = model.n_params
+    if callable(n_params):
+        n_params = n_params(params)
     plan, survivors = _plan_for_survivors(
-        model, model.n_params(params), list(surviving_devices),
+        model, int(n_params), list(surviving_devices),
         batch_per_device, global_batch, planner_overrides,
     )
     new_mesh = build_mesh(plan.spec, survivors)
@@ -252,7 +309,12 @@ def reconfigure(
     # caller accepted a torn state — those pieces substitute zeros); any leaf
     # touching a dead device is reassembled from surviving shards, never
     # fetched whole; device_put lays the state out fresh on the new mesh
-    pspecs = model.param_specs(pp=plan.spec.pp > 1, fsdp=plan.spec.fsdp)
+    if hasattr(model, "param_specs"):
+        pspecs = model.param_specs(pp=plan.spec.pp > 1, fsdp=plan.spec.fsdp)
+    else:
+        # dp-only models (MLP/CNN) carry no spec tree: params are
+        # replicated, which is exactly what a data-parallel step expects
+        pspecs = jax.tree.map(lambda _: P(), params)
 
     host_params, host_opt = _pull_host_state(
         params, opt_state, lost_devices,
@@ -272,9 +334,14 @@ def reconfigure(
     new_params, new_opt = _place_state(
         host_params, host_opt, optimizer, pspecs, new_mesh
     )
+    new_ef = None
+    if error_feedback is not None:
+        new_ef = remap_error_feedback(
+            error_feedback, new_mesh, axis=ef_axis, lost_devices=lost_devices
+        )
     return ElasticState(
         params=new_params, opt_state=new_opt, mesh=new_mesh, spec=plan.spec,
-        reasons=plan.reasons + torn_note,
+        reasons=plan.reasons + torn_note, error_feedback=new_ef,
     )
 
 

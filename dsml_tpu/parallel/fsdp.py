@@ -19,6 +19,20 @@ Memory-efficiency capability (reference: literature only — SURVEY.md §2.4
   updated shards all-gather back per bucket. This is the explicit
   reduce-scatter data path the reference's ring schedule implied but never
   delivered, with the bucket granularity production DP stacks use.
+
+  With ``quant="int8"`` / ``"int4"`` / ``"auto"`` the gradient
+  reduce-scatter runs the BLOCK-QUANTIZED ring schedule
+  (``ops.quantization.quantized_flat_reduce_scatter``): each of the n−1
+  hops ships 8/4-bit chunks + f32 block scales instead of full-precision
+  buckets — the compressed end-to-end ZeRO-2 sync. ``"auto"`` resolves the
+  scheme per bucket dtype from ``DSML_QUANT``. ``error_feedback=True``
+  adds per-rank residual state (EF-SGD: the compression error re-enters
+  the next step's gradients) — the step then runs
+  ``(params, opt_state, ef, x, y) -> (params, opt_state, ef, loss)``, with
+  ``ef`` from ``parallel.bucketing.init_error_feedback(params, mesh,
+  axis)``. The updated-param all-gather half stays full precision: params
+  must land bit-identical on every rank (replication invariant), so only
+  the gradient half — the hot, error-tolerant direction — compresses.
 """
 
 from __future__ import annotations
@@ -29,7 +43,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dsml_tpu.obs import record_collective_plan
+from dsml_tpu.obs import record_collective_plan, record_quant_sync_bytes
 from dsml_tpu.ops.collectives import ReduceOp, flat_all_gather, flat_reduce_scatter
 from dsml_tpu.parallel.bucketing import (
     _leaf_size,
@@ -254,6 +268,27 @@ def restore_zero2(
     return state["params"], state["opt_state"]
 
 
+def _zero2_scheme_for(quant: str | None, dtype) -> str | None:
+    """Which quant scheme a ZeRO-2 bucket of ``dtype`` reduce-scatters
+    with: ``None`` (full precision), a fixed scheme, or ``"auto"`` → the
+    ``DSML_QUANT`` per-dtype choice (its algorithm half is irrelevant here
+    — a reduce-scatter is single-direction by construction)."""
+    if quant is None or not jnp.issubdtype(dtype, jnp.floating):
+        return None
+    if quant in ("int8", "int4"):
+        return quant
+    if quant == "auto":
+        from dsml_tpu.ops.quantization import quant_algorithm_for
+
+        algo = quant_algorithm_for(dtype)
+        if algo.startswith("q8"):
+            return "int8"
+        if algo.startswith("q4"):
+            return "int4"
+        return None  # DSML_QUANT=none
+    raise ValueError(f"unknown zero2 quant mode {quant!r}; use int8/int4/auto/None")
+
+
 def make_zero2_train_step(
     loss_fn,
     optimizer: optax.GradientTransformation,
@@ -261,6 +296,8 @@ def make_zero2_train_step(
     axis: str = "fsdp",
     bucket_size_mb: float | None | str = "auto",
     donate: bool = True,
+    quant: str | None = None,
+    error_feedback: bool = False,
 ):
     """Explicit ZeRO-2: ``step(params, opt_state, x, y)`` with replicated
     params, per-bucket gradient REDUCE-SCATTER, optimizer on each rank's
@@ -274,56 +311,174 @@ def make_zero2_train_step(
     a number = that many MiB, ``None`` = one bucket per dtype (the
     single-buffer A/B shape: the whole gradient space reduce-scatters as
     one collective per dtype — no backward/comm overlap possible).
+
+    ``quant``: ``"int8"`` / ``"int4"`` runs each float bucket's
+    reduce-scatter as the block-quantized ring (n−1 hops at 8/4 bits per
+    element + f32 block scales); ``"auto"`` resolves per bucket dtype from
+    ``DSML_QUANT``; ``None`` (default) is the full-precision psum-scatter.
+    The gradient shard a rank is left with is bit-identical across the
+    quantized and unquantized layouts' SHAPES, so the sharded optimizer
+    state from :func:`init_zero2` fits unchanged. ``error_feedback=True``
+    (requires ``quant``) threads per-rank residual state through the step
+    — signature becomes ``(params, opt_state, ef, x, y)`` with ``ef`` from
+    ``parallel.bucketing.init_error_feedback(params, mesh, axis)``.
     """
     if bucket_size_mb == "auto":
         bucket_size_mb = default_bucket_mb()
+    if error_feedback and quant is None:
+        raise ValueError("error_feedback=True requires quant= (int8/int4/auto)")
+    if quant is not None and quant not in ("int8", "int4", "auto"):
+        raise ValueError(f"unknown zero2 quant mode {quant!r}; use int8/int4/auto/None")
     n = mesh.shape[axis]
     batch_sh = NamedSharding(mesh, P(axis))
+    ef_sh = NamedSharding(mesh, P(axis))
     optimizer = optax.with_extra_args_support(optimizer)
     # None → a single huge target so every dtype packs into ONE bucket
     plan_mb = bucket_size_mb if bucket_size_mb is not None else float("inf")
+    quant_bytes_cell: dict = {}
 
-    def step(params, opt_state, x, y):
-        plan = plan_buckets(params, plan_mb)
-        specs = _opt_specs(opt_state, axis)
-        # trace-time: the ZeRO-2 reduce-scatter plan, labeled "zero2" next
-        # to the dp algorithms in the same registry metrics (None means
-        # per-dtype buckets HERE, unlike dp's single ravel buffer — pass
-        # the resolved plan_mb so the recorder models what actually runs)
-        record_collective_plan("zero2", params, plan_mb, axis)
+    def _grad_shards(gbuckets, plan, ef_buckets):
+        """Per-bucket reduce-scatter (quantized where configured) → each
+        rank's flat gradient shards + the fresh EF residual buckets."""
+        from dsml_tpu.parallel.bucketing import _q8_bucket_seed
 
-        def shard_fn(params, opt_state, x, y):
-            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
-            loss = lax.pmean(loss, axis)
-            gbuckets = flatten_buckets(grads, plan)
-            sizes = [g.shape[0] for g in gbuckets]
-            # one reduce-scatter per bucket: independent collectives the
-            # scheduler can overlap with still-running backward compute
-            gshards = [flat_reduce_scatter(g, axis, ReduceOp.AVG)[0] for g in gbuckets]
-            pshards = _local_shards(flatten_buckets(params, plan), axis, n)
-            updates, opt_state = optimizer.update(
-                gshards, opt_state, pshards, value=loss
+        gshards, new_ef = [], []
+        for b, g in enumerate(gbuckets):
+            scheme = _zero2_scheme_for(quant, g.dtype)
+            if scheme is None:
+                if ef_buckets is not None and jnp.issubdtype(g.dtype, jnp.floating):
+                    # exact exchange drains the standing residual
+                    adj = g.astype(jnp.float32) + ef_buckets[b]
+                    shard = flat_reduce_scatter(adj, axis, ReduceOp.AVG)[0]
+                    new_ef.append(jnp.zeros_like(ef_buckets[b]))
+                else:
+                    shard = flat_reduce_scatter(g, axis, ReduceOp.AVG)[0]
+                    if ef_buckets is not None:
+                        new_ef.append(ef_buckets[b])
+                gshards.append(shard.astype(g.dtype))
+                continue
+            from dsml_tpu.ops.quantization import (
+                quantize_roundtrip,
+                quantized_flat_reduce_scatter,
             )
-            new_shards = optax.apply_updates(pshards, updates)
-            new_buckets = [
-                flat_all_gather(s, axis, size)
-                for s, size in zip(new_shards, sizes)
-            ]
-            return unflatten_buckets(new_buckets, plan), opt_state, loss
 
-        return jax.shard_map(
-            shard_fn,
-            mesh=mesh,
-            in_specs=(P(), specs, P(axis), P(axis)),
-            out_specs=(P(), specs, P()),
-            check_vma=False,
-        )(params, opt_state, x, y)
+            if ef_buckets is None:
+                shard, _ = quantized_flat_reduce_scatter(
+                    g, axis, scheme, mean=True, stochastic=True,
+                    seed=_q8_bucket_seed(g, b),
+                )
+            else:
+                adj = g.astype(jnp.float32) + ef_buckets[b]
+                shard, _ = quantized_flat_reduce_scatter(
+                    adj, axis, scheme, mean=True, stochastic=False,
+                )
+                new_ef.append(adj - quantize_roundtrip(adj, scheme))
+            gshards.append(shard.astype(g.dtype))
+        return gshards, new_ef
 
-    jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    def make_step(with_ef: bool):
+        def step(params, opt_state, *rest):
+            if with_ef:
+                ef, x, y = rest
+            else:
+                x, y = rest
+            plan = plan_buckets(params, plan_mb)
+            specs = _opt_specs(opt_state, axis)
+            # trace-time: the ZeRO-2 reduce-scatter plan, labeled "zero2"
+            # next to the dp algorithms in the same registry metrics (None
+            # means per-dtype buckets HERE, unlike dp's single ravel buffer
+            # — pass the resolved plan_mb so the recorder models what
+            # actually runs)
+            record_collective_plan(
+                "zero2" if quant is None else f"zero2_{quant}",
+                params, plan_mb, axis,
+            )
+            if quant is not None and not quant_bytes_cell:
+                from dsml_tpu.parallel.bucketing import plan_quant_wire_bytes
+
+                # a reduce-scatter is the scatter-reduce half of the ring:
+                # half the all-reduce's hop count, so half its wire bytes
+                algo = {"int8": "q8_ring", "int4": "q4_ring", "auto": "quant"}[quant]
+                quant_bytes_cell.update({
+                    scheme: nbytes // 2
+                    for scheme, nbytes in
+                    plan_quant_wire_bytes(plan, n, algo).items()
+                })
+
+            def shard_fn(params, opt_state, *tail):
+                if with_ef:
+                    ef, x, y = tail
+                else:
+                    ef = None
+                    x, y = tail
+                loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+                loss = lax.pmean(loss, axis)
+                gbuckets = flatten_buckets(grads, plan)
+                sizes = [g.shape[0] for g in gbuckets]
+                ef_buckets = None
+                if with_ef:
+                    ef_local = jax.tree.map(lambda l: l[0], ef)
+                    ef_buckets = flatten_buckets(ef_local, plan)
+                # one reduce-scatter per bucket: independent collectives the
+                # scheduler can overlap with still-running backward compute
+                gshards, new_ef = _grad_shards(gbuckets, plan, ef_buckets)
+                pshards = _local_shards(flatten_buckets(params, plan), axis, n)
+                updates, opt_state = optimizer.update(
+                    gshards, opt_state, pshards, value=loss
+                )
+                new_shards = optax.apply_updates(pshards, updates)
+                new_buckets = [
+                    flat_all_gather(s, axis, size)
+                    for s, size in zip(new_shards, sizes)
+                ]
+                out = (unflatten_buckets(new_buckets, plan), opt_state, loss)
+                if with_ef:
+                    from dsml_tpu.parallel.bucketing import _ef_plan
+
+                    ef_tree = unflatten_buckets(new_ef, _ef_plan(plan))
+                    out = out + (jax.tree.map(lambda l: l[None], ef_tree),)
+                return out
+
+            out_specs = (P(), specs, P()) + ((P(axis),) if with_ef else ())
+            in_specs = (P(), specs) + ((P(axis),) if with_ef else ()) + (P(axis), P(axis))
+            args = (params, opt_state) + ((ef,) if with_ef else ()) + (x, y)
+            res = jax.shard_map(
+                shard_fn,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                check_vma=False,
+            )(*args)
+            if with_ef:
+                new_params, opt_state, loss, new_ef = res
+                return new_params, opt_state, new_ef, loss
+            new_params, opt_state, loss = res
+            return new_params, opt_state, loss
+
+        return step
+
+    if error_feedback:
+        jitted = jax.jit(
+            make_step(True), donate_argnums=(0, 1, 2) if donate else ()
+        )
+
+        def run(params, opt_state, ef, x, y):
+            x = jax.device_put(x, batch_sh)
+            y = jax.device_put(y, batch_sh)
+            out = jitted(params, opt_state, ef, x, y)
+            record_quant_sync_bytes(quant_bytes_cell, f"zero2_{quant}", axis)
+            return out
+
+        return run
+
+    jitted = jax.jit(make_step(False), donate_argnums=(0, 1) if donate else ())
 
     def run(params, opt_state, x, y):
         x = jax.device_put(x, batch_sh)
         y = jax.device_put(y, batch_sh)
-        return jitted(params, opt_state, x, y)
+        out = jitted(params, opt_state, x, y)
+        if quant is not None:
+            record_quant_sync_bytes(quant_bytes_cell, f"zero2_{quant}", axis)
+        return out
 
     return run

@@ -111,16 +111,24 @@ def make_hybrid_train_step(
     ``dp_sync`` picks the gradient-sync mechanism on dp-ONLY meshes (every
     other axis size 1): ``"xla"`` (default) keeps the shard_map-transpose
     psum — one sync per microbatch, XLA's collective choice. Any explicit
-    algorithm (``"ring"``/``"ring2"``/``"naive"``/``"auto"``/``"q8"``)
+    algorithm (``"ring"``/``"ring2"``/``"naive"``/``"auto"``/``"q8"``, or
+    the block-quantized ring family ``"q8_ring"``/``"q8_ring2"``/
+    ``"q4_ring"``/``"q4_ring2"``/``"quant"`` — int8/int4 inside the
+    2(n−1)-step schedule, ``DSML_QUANT`` resolves ``"quant"`` per dtype)
     instead accumulates LOCAL per-rank gradients across the grad-accum
     microbatches and syncs ONCE per step as per-bucket collectives
     (``parallel.bucketing``, ~``bucket_size_mb`` MiB each, ``"auto"`` =
     the 4 MiB env default, ``None`` = one buffer) — grad_accum× fewer
-    bytes on the wire and per-bucket overlap with the backward. Per-rank
-    differentiation is exact here precisely because no collective crosses
-    ranks inside the loss on a dp-only mesh; meshes with tp/sp/pp/fsdp > 1
-    reject explicit ``dp_sync`` rather than compute silently-wrong
-    cotangents.
+    bytes on the wire and per-bucket overlap with the backward. Grad-accum
+    composes especially well with the quantized syncs: accumulation stays
+    full-precision on-device, so quantization noise enters once per step,
+    not once per microbatch. (Error-feedback residual state threads
+    through the dp/zero2 step builders, which own their state signatures —
+    here use ``parallel.dp.make_dp_train_step(error_feedback=True)`` for
+    the EF variant.) Per-rank differentiation is exact here precisely
+    because no collective crosses ranks inside the loss on a dp-only mesh;
+    meshes with tp/sp/pp/fsdp > 1 reject explicit ``dp_sync`` rather than
+    compute silently-wrong cotangents.
     """
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")

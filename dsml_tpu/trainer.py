@@ -40,7 +40,8 @@ class TrainConfig(Config):
     warmup_steps: int = field(0, help="linear warmup steps for the schedule")
     plateau_patience: int = field(5, help="plateau schedule: epochs-worth of steps without improvement before decaying")
     plateau_factor: float = field(0.5, help="plateau schedule: lr decay factor")
-    algorithm: str = field("xla", help="gradient sync: xla | ring | ring2 | auto | naive | q8 (int8-compressed)")
+    algorithm: str = field("xla", help="gradient sync: xla | ring | ring2 | auto | naive | q8 (v1 int8 gather) | q8_ring | q8_ring2 | q4_ring | q4_ring2 (block-quantized ring schedules) | quant (per-dtype via DSML_QUANT)")
+    error_feedback: bool = field(False, help="error-feedback residuals for quantized ring sync (q8_ring/q8_ring2/q4_ring/q4_ring2/quant): the per-rank compression error re-enters the next step's gradients; residuals are checkpointable state and ride resume bit-identically")
     bucket_mb: float = field(0.0, help="explicit-sync gradient bucket size in MiB (0 = the DSML_BUCKET_MB default, currently 4; negative = single buffer, the pre-bucketing A/B shape)")
     dp: int = field(0, help="data-parallel devices (0 = all local)")
     seed: int = field(0, help="init + shuffle seed")
@@ -97,6 +98,7 @@ class Trainer:
         self.metrics = MetricsLogger(self.config.log_metrics or None)
         self._step_fn = None
         self._eval_fn = None
+        self._ef_norm_fn = None
 
     def _build(self, steps_per_epoch: int):
         optimizer = _make_optimizer(self.config, steps_per_epoch)
@@ -105,6 +107,7 @@ class Trainer:
         self._step_fn = make_dp_train_step(
             self.model.loss, optimizer, self.mesh, algorithm=self.config.algorithm,
             bucket_size_mb="auto" if bucket == 0 else (None if bucket < 0 else bucket),
+            error_feedback=self.config.error_feedback,
         )
         self._eval_fn = make_eval_step(self.model, self.mesh)
         return optimizer
@@ -123,6 +126,13 @@ class Trainer:
             # survive the first step.
             params = jax.tree.map(lambda a: jax.numpy.array(a), params)
         opt_state = optimizer.init(params)
+        ef = None
+        if cfg.error_feedback:
+            # per-rank compression residuals (EF-SGD): sharded over dp so
+            # each device stores only its own; checkpointable state below
+            from dsml_tpu.parallel.bucketing import init_error_feedback
+
+            ef = init_error_feedback(params, self.mesh, "dp")
 
         ckpt = None
         start_epoch = 1
@@ -147,9 +157,17 @@ class Trainer:
                         "backend='orbax') or start a fresh checkpoint_dir"
                     )
             if cfg.resume and ckpt.latest_step() is not None:
-                state = ckpt.restore(template={"params": params, "opt_state": opt_state,
-                                               "meta": {"epoch": 0}})
+                template = {"params": params, "opt_state": opt_state,
+                            "meta": {"epoch": 0}}
+                if ef is not None:
+                    # EF residuals ride the manifest like any state tree;
+                    # restoring them is what keeps a kill-and-resume under
+                    # quantized sync bit-identical to the unkilled run
+                    template["ef"] = ef
+                state = ckpt.restore(template=template)
                 params, opt_state = state["params"], state["opt_state"]
+                if ef is not None:
+                    ef = state["ef"]
                 it_state = ckpt.iterator_state() or {}
                 if int(it_state.get("consumed", 0)) > 0:
                     # mid-epoch checkpoint (save_every_steps): restart
@@ -225,9 +243,12 @@ class Trainer:
             enqueue (the commit rides the writer thread and surfaces as
             checkpoint_commit_ms)."""
             t_save = time.perf_counter()
+            state = {"params": params, "opt_state": opt_state,
+                     "meta": {"epoch": epochs_done}}
+            if ef is not None:
+                state["ef"] = ef
             ckpt.save(global_step if save_every_steps else epochs_done,
-                      {"params": params, "opt_state": opt_state,
-                       "meta": {"epoch": epochs_done}},
+                      state,
                       iterator_state={"epoch": it_epoch,
                                       "consumed": consumed_now},
                       wait=wait)
@@ -284,7 +305,11 @@ class Trainer:
                         if track:
                             t_data = time.perf_counter()
                             breakdown.add("data", t_data - t_prev)
-                        params, opt_state, loss = self._step_fn(params, opt_state, x, y)
+                        if ef is not None:
+                            params, opt_state, ef, loss = self._step_fn(
+                                params, opt_state, ef, x, y)
+                        else:
+                            params, opt_state, loss = self._step_fn(params, opt_state, x, y)
                         if track:
                             t_disp = time.perf_counter()
                             breakdown.add("step_dispatch", t_disp - t_data)
@@ -310,6 +335,17 @@ class Trainer:
                                     # halt-policy trips raise SentinelTripped out of
                                     # train() with the postmortem bundle already on disk
                                     sentinels.check(global_step, loss_host)
+                            if track and ef is not None:
+                                # residual health at the existing sync point
+                                # (the step already blocked — one small
+                                # jitted norm + host read per sync window)
+                                if self._ef_norm_fn is None:
+                                    self._ef_norm_fn = jax.jit(optax.global_norm)
+                                obs_reg.gauge(
+                                    "quant_error_feedback_norm",
+                                    "global L2 norm of the error-feedback "
+                                    "residual tree (sampled at loss syncs)",
+                                ).set(float(self._ef_norm_fn(ef)))
                         if track:
                             now = time.perf_counter()
                             breakdown.note_step_wall(now - t_prev)

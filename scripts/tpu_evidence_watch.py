@@ -39,6 +39,9 @@ SECTIONS = [
     ("migration", 600),   # P2P shard-motion MB/s + recovery split (runs on
     #                       the virtual-8 CPU mesh in a subprocess; the
     #                       delivery/integrity verdicts are the signal)
+    ("quant_sweep", 900),  # block-quantized collective grid + q8+EF parity
+    #                        (virtual-8 CPU subprocess; the wire-reduction
+    #                        and parity verdicts are the signal)
     ("gpt2_decode", 1200),  # plain + wq8 + kv8 + kv4 variants, 2 compiles each
     ("allreduce", 600),   # incl. the e2e wire-path row (VERDICT r3 item 7)
     ("gpt2_seq8k", 900),

@@ -192,6 +192,43 @@ def test_cluster_section_schema(tmp_path, monkeypatch):
     assert prof["schema"] == "dsml.obs.collective_profile/1"
 
 
+def test_quant_sweep_section_schema(monkeypatch):
+    """The BENCH `quant_sweep` section's contract (ISSUE 9 acceptance):
+    the (bucket × scheme × algorithm) grid reports per-cell sync ms +
+    analytic wire bytes, the quantized ring's wire-byte reduction vs the
+    fp32 ring at equal bucket size is ≥ 2× (int8 ~4×, int4 ~8× — a
+    counting argument over the schedule, not a CPU-timing claim), and the
+    q8+EF loss trajectory stays within the stated tolerance of the fp32
+    sync. Runs the TINY grid (the same one the CI smoke step uses)."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    monkeypatch.setenv("DSML_QUANT_SWEEP_TINY", "1")
+    rows = bench.bench_quant_sweep()
+
+    assert "quant_sweep_error" not in rows, rows
+
+    # (a) grid cells: per-sync ms + bucket counts for every tiny-grid cell
+    for alg in ("ring", "q8_ring"):
+        assert rows[f"quant_sweep_{alg}_4mb_ms"] >= 0
+        assert rows[f"quant_sweep_{alg}_4mb_buckets"] >= 1
+
+    # (b) the acceptance bar: quantized ring ships ≥2× fewer wire bytes
+    # than the fp32 ring at equal bucket size (analytic, static shapes)
+    assert rows["quant_sweep_int8_ring_wire_reduction"] >= 2.0
+    assert rows["quant_sweep_int8_ring2_wire_reduction"] >= 2.0
+    assert rows["quant_sweep_int4_ring_wire_reduction"] >= 4.0
+    assert rows["quant_sweep_fp32_ring_wire_bytes_per_bucket"] > \
+        rows["quant_sweep_q8_ring_wire_bytes_per_bucket"]
+
+    # (c) q8+EF parity: measured loss trajectory within the stated
+    # tolerance of the fp32 ring sync, and the verdict row says so
+    assert rows["quant_sweep_parity_q8_ef_rel_dev"] <= \
+        rows["quant_sweep_parity_tolerance"]
+    assert rows["quant_sweep_parity_q8_ef_ok"] is True
+    assert rows["quant_sweep_parity_steps"] > 0
+
+
 @pytest.mark.slow
 def test_cpu_fallback_emits_under_hung_probe():
     """The capped-preflight path: probe hangs, preflight gives up inside its

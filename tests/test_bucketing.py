@@ -60,12 +60,46 @@ def test_small_target_splits_large_target_packs():
     assert few.n_buckets == n_dtypes
 
 
-def test_q8_rejects_non_linear_ops_single_buffer_too():
-    """The SUM/AVG guard must fire on BOTH paths — bucket_size_mb=None used
-    to slip past it and silently compute a quantized SUM for MAX."""
+@pytest.mark.parametrize(
+    "algorithm", ["q8", "q8_ring", "q8_ring2", "q4_ring", "q4_ring2", "quant"]
+)
+def test_quantized_rejects_non_linear_ops_single_buffer_too(algorithm):
+    """The SUM/AVG guard must fire on BOTH paths for EVERY quantized
+    algorithm — bucket_size_mb=None used to slip past it for q8 and
+    silently compute a quantized SUM for MAX; the ring family inherits the
+    same guard (ISSUE 9 satellite)."""
     for mb in (None, 4.0):
         with pytest.raises(ValueError, match="SUM/AVG"):
-            B.bucketed_all_reduce({"w": jnp.zeros(4)}, "dev", ReduceOp.MAX, "q8", mb)
+            B.bucketed_all_reduce({"w": jnp.zeros(4)}, "dev", ReduceOp.MAX, algorithm, mb)
+
+
+def test_zero2_quant_guards():
+    """The quantized ZeRO-2 front door rejects unknown schemes and EF
+    without quantization (the misconfigurations that would otherwise
+    silently train full-precision)."""
+    from dsml_tpu.parallel.fsdp import make_zero2_train_step
+    from dsml_tpu.parallel.mesh import data_mesh
+
+    mesh = data_mesh()
+    with pytest.raises(ValueError, match="quant"):
+        make_zero2_train_step(lambda p, x, y: 0.0, optax.sgd(0.1), mesh,
+                              quant="int2")
+    with pytest.raises(ValueError, match="error_feedback"):
+        make_zero2_train_step(lambda p, x, y: 0.0, optax.sgd(0.1), mesh,
+                              error_feedback=True)
+
+
+def test_dp_error_feedback_requires_quantized_ring(devices8):
+    from dsml_tpu.models.mlp import MLP
+    from dsml_tpu.parallel.dp import make_dp_train_step
+    from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    model = MLP(sizes=(8, 4))
+    mesh = build_mesh(MeshSpec(dp=8), devices8)
+    for algorithm in ("ring", "q8", "xla"):
+        with pytest.raises(ValueError, match="error_feedback"):
+            make_dp_train_step(model.loss, optax.sgd(0.1), mesh,
+                               algorithm=algorithm, error_feedback=True)
 
 
 def test_default_bucket_mb_rejects_non_positive(monkeypatch):
@@ -193,6 +227,152 @@ def test_dp_step_bucketed_matches_xla(devices8, algorithm, bucket_mb):
     np.testing.assert_allclose(
         run(algorithm, bucket_mb), run("xla", None), rtol=1e-4
     )
+
+
+@pytest.mark.parametrize("algorithm", ["q8_ring", "q8_ring2", "q4_ring2", "quant"])
+def test_bucketed_quant_ring_close_to_mean(mesh8, algorithm):
+    """The v2 block-quantized ring algorithms through the bucketing layer:
+    close to the exact mean on a mixed-size float tree (the per-bucket
+    counterpart of the core ring tests)."""
+    stack = _float_stack(7)
+    got = _sync(mesh8, stack, algorithm, 1e-3)
+    expected = jax.tree.map(lambda l: np.asarray(l).mean(axis=0), stack)
+    # per-element error ≈ one quantum of the accumulated partial sums
+    # (absmax ≈ n·|x|max ⇒ quantum ≈ n·|x|max/qmax, ÷n for AVG): int4's 15
+    # levels land near 0.5 on standard-normal data — the calibrated bound
+    # lives in test_quantization; this pins the bucketing PLUMBING
+    for k in stack:
+        qmax = 7 if algorithm.startswith("q4") else 127
+        tol = float(np.abs(np.asarray(stack[k])).max()) / qmax * 1.6 + 1e-4
+        np.testing.assert_allclose(
+            np.asarray(got[k])[0], expected[k], atol=tol, rtol=0, err_msg=k
+        )
+
+
+def test_bucketed_quant_ring_mixed_dtypes_int_exact(mesh8):
+    """Integer buckets under a quantized algorithm ride the plain ring and
+    stay EXACT (quantizing integer gradients would corrupt them)."""
+    stack = {
+        "f": jnp.asarray(np.random.default_rng(0).standard_normal((8, 100)), jnp.float32),
+        "i": jnp.asarray(np.arange(8 * 6).reshape(8, 6), jnp.int32),
+    }
+    got = _sync(mesh8, stack, "q8_ring", 1e-3, op=ReduceOp.SUM)
+    np.testing.assert_array_equal(
+        np.asarray(got["i"])[0], np.asarray(stack["i"]).sum(axis=0)
+    )
+
+
+def test_dp_step_quant_ring_matches_xla_trajectory(devices8):
+    """The wired dp frontend at q8_ring tracks the fp32 XLA-sync loss
+    trajectory within quantization noise, and with error feedback at
+    least as closely (the ISSUE 9 parity bar, pinned cheaply here; the
+    bench quant_sweep section carries the measured grid)."""
+    from dsml_tpu.models.mlp import MLP
+    from dsml_tpu.parallel.bucketing import init_error_feedback
+    from dsml_tpu.parallel.dp import make_dp_train_step
+    from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+    from dsml_tpu.utils.data import synthetic_classification
+
+    mesh = build_mesh(MeshSpec(dp=8), devices8)
+    model = MLP(sizes=(32, 64, 4))
+    data = synthetic_classification(256, features=32, classes=4, seed=0)
+    x, y = data.train_x[:64], data.train_y[:64]
+    opt = optax.adamw(1e-2)
+
+    def run(algorithm, ef_on):
+        step = make_dp_train_step(model.loss, opt, mesh, algorithm=algorithm,
+                                  bucket_size_mb=1e-3, error_feedback=ef_on)
+        p, o = model.init(0), opt.init(model.init(0))
+        ef = init_error_feedback(p, mesh, "dp") if ef_on else None
+        out = []
+        for _ in range(5):
+            if ef_on:
+                p, o, ef, loss = step(p, o, ef, x, y)
+            else:
+                p, o, loss = step(p, o, x, y)
+            out.append(float(loss))
+        return out
+
+    ref = run("xla", False)
+    for algorithm, ef_on in (("q8_ring", False), ("q8_ring2", True)):
+        got = run(algorithm, ef_on)
+        assert all(np.isfinite(got))
+        dev = max(abs(a - b) / max(abs(b), 1e-2) for a, b in zip(got, ref))
+        assert dev < 0.06, (algorithm, ef_on, got, ref)
+
+
+@pytest.mark.parametrize("quant,ef_on", [("int8", False), ("int8", True), ("int4", True)])
+def test_zero2_quant_tracks_replicated_trajectory(devices8, quant, ef_on):
+    """Quantized ZeRO-2 end-to-end: per-bucket QUANTIZED ring
+    reduce-scatter (+ optional EF), sharded optimizer on the same shard
+    shapes as the fp32 path, per-bucket all-gather — the loss trajectory
+    tracks the replicated dp reference within the scheme's noise."""
+    from dsml_tpu.models.mlp import MLP
+    from dsml_tpu.parallel.bucketing import init_error_feedback
+    from dsml_tpu.parallel.dp import make_dp_train_step
+    from dsml_tpu.parallel.fsdp import init_zero2, make_zero2_train_step
+    from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+    from dsml_tpu.utils.data import synthetic_classification
+
+    model = MLP(sizes=(32, 64, 4))
+    data = synthetic_classification(256, features=32, classes=4, seed=0)
+    x, y = data.train_x[:64], data.train_y[:64]
+    opt = optax.adamw(1e-2)
+
+    mesh_dp = build_mesh(MeshSpec(dp=8), devices8)
+    step_ref = make_dp_train_step(model.loss, opt, mesh_dp)
+    p_ref, o_ref = model.init(0), opt.init(model.init(0))
+    ref = []
+    for _ in range(5):
+        p_ref, o_ref, loss = step_ref(p_ref, o_ref, x, y)
+        ref.append(float(loss))
+
+    mesh = build_mesh(MeshSpec(dp=1, fsdp=8), devices8)
+    params, ostate = init_zero2(model, opt, mesh, seed=0, bucket_size_mb=1e-3)
+    step = make_zero2_train_step(model.loss, opt, mesh, bucket_size_mb=1e-3,
+                                 quant=quant, error_feedback=ef_on)
+    ef = init_error_feedback(params, mesh, "fsdp") if ef_on else None
+    got = []
+    for _ in range(5):
+        if ef_on:
+            params, ostate, ef, loss = step(params, ostate, ef, x, y)
+        else:
+            params, ostate, loss = step(params, ostate, x, y)
+        got.append(float(loss))
+    assert all(np.isfinite(got))
+    tol = 0.25 if quant == "int4" else 0.06
+    dev = max(abs(a - b) / max(abs(b), 1e-2) for a, b in zip(got, ref))
+    assert dev < tol, (quant, ef_on, got, ref)
+
+
+def test_init_error_feedback_shape_and_sharding(devices8):
+    from dsml_tpu.parallel.bucketing import init_error_feedback
+    from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(dp=8), devices8)
+    tree = {"w": jnp.zeros((3, 2), jnp.bfloat16), "i": jnp.zeros((5,), jnp.int32)}
+    ef = init_error_feedback(tree, mesh, "dp")
+    # residuals: one f32 row per rank regardless of gradient dtype, sharded
+    # so each device stores only its own
+    assert ef["w"].shape == (8, 3, 2) and ef["w"].dtype == jnp.float32
+    assert ef["i"].shape == (8, 5)
+    assert ef["w"].addressable_shards[0].data.shape[0] == 1
+
+
+def test_plan_quant_wire_bytes_schemes():
+    from dsml_tpu.parallel.bucketing import plan_quant_wire_bytes
+
+    tree = {
+        "f": jnp.zeros((70_000,), jnp.float32),
+        "i": jnp.zeros((1_000,), jnp.int32),
+    }
+    plan = B.plan_buckets(tree, 4.0)
+    by_scheme = plan_quant_wire_bytes(plan, 8, "q8_ring")
+    assert set(by_scheme) == {"int8", "fp32"}  # int bucket rides fp32 ring
+    assert by_scheme["int8"] > 0 and by_scheme["fp32"] > 0
+    # v1 q8 (gather exchange): O(n) per rank — strictly more than the ring
+    gather = plan_quant_wire_bytes(plan, 8, "q8")
+    assert gather["int8"] > by_scheme["int8"]
 
 
 def test_dp_step_q8_bucketed_trains(devices8):

@@ -493,3 +493,49 @@ def test_manifest_is_valid_json_with_sharding_audit(dp_mesh8, tmp_path):
     assert "dp" in axes
     assert entry["sharding"]["mesh_shape"][axes.index("dp")] == 8
     assert entry["dtype"] == "float32" and entry["shape"] == [16, 2]
+
+
+def test_kill_and_resume_bit_identical_q8_ef(dp_mesh8, tmp_path):
+    """ISSUE 9 satellite: error-feedback residuals ride the checkpoint
+    manifest, so a killed q8_ring+EF run resumes BIT-IDENTICAL to the
+    uninterrupted one — the EF path's deterministic rounding makes the
+    whole trajectory reproducible, and dropping the residuals at resume
+    would fork it (the compressor would owe different mass)."""
+    import jax
+    import numpy as np
+
+    from dsml_tpu.checkpoint import CheckpointManager
+    from dsml_tpu.models.mlp import MLP
+    from dsml_tpu.trainer import TrainConfig, Trainer
+    from dsml_tpu.utils.data import synthetic_classification
+
+    data = synthetic_classification(512, features=16, classes=4, seed=0)
+    model = MLP(sizes=(16, 32, 4))
+
+    def run(epochs, ckdir, resume=False):
+        cfg = TrainConfig(epochs=epochs, batch_size=32, lr=0.05,
+                          optimizer="momentum", algorithm="q8_ring",
+                          error_feedback=True, checkpoint_dir=ckdir,
+                          save_every=1, resume=resume, seed=3)
+        params, _, _ = Trainer(model, cfg, mesh=dp_mesh8).train(data)
+        return params
+
+    straight = run(4, str(tmp_path / "a"))
+    run(2, str(tmp_path / "b"))
+    # the manifest really carries the residual tree (not just params/opt)
+    with CheckpointManager(str(tmp_path / "b")) as m:
+        import json as _json
+        import os as _os
+
+        from dsml_tpu.checkpoint import native
+
+        with open(_os.path.join(m.directory, native.step_dirname(2),
+                                native.MANIFEST)) as f:
+            manifest = _json.load(f)
+        assert any(leaf["path"].startswith("ef") for leaf in manifest["leaves"])
+    resumed = run(4, str(tmp_path / "b"), resume=True)
+    same = jax.tree.map(
+        lambda a, b: bool((np.asarray(a) == np.asarray(b)).all()),
+        straight, resumed,
+    )
+    assert all(jax.tree_util.tree_leaves(same)), same

@@ -1,6 +1,7 @@
-"""Int8 stochastic quantization + compressed gradient sync, and the
-hierarchical two-level all-reduce (communication/memory literature parity,
-SURVEY.md §2.4 folders 6-7)."""
+"""Int8 stochastic quantization + compressed gradient sync, the
+block-quantized ring schedules (int8/int4 inside the 2(n−1)-step ring,
+EQuARX-style — ISSUE 9), and the hierarchical two-level all-reduce
+(communication/memory literature parity, SURVEY.md §2.4 folders 6-7)."""
 
 import jax
 import jax.numpy as jnp
@@ -8,13 +9,27 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
-from dsml_tpu.ops.collectives import ReduceOp, all_reduce, hierarchical_all_reduce
+from dsml_tpu.ops.collectives import (
+    ReduceOp,
+    all_reduce,
+    hierarchical_all_reduce,
+    ring_wire_bytes,
+)
 from dsml_tpu.ops.quantization import (
     QuantizedTensor,
     compressed_all_reduce,
     compressed_checkpoint,
     dequantize_int8,
+    default_qblock,
+    get_scheme,
+    pack_int4,
+    quant_algorithm_for,
     quantize_int8,
+    quantize_roundtrip,
+    quantized_flat_reduce_scatter,
+    quantized_ring_all_reduce,
+    quantized_ring_wire_bytes,
+    unpack_int4,
 )
 
 
@@ -257,6 +272,276 @@ def test_quantized_tensor_static_metadata():
     assert len(leaves) == 2  # values, scales only
     rebuilt = jax.tree.unflatten(treedef, leaves)
     assert isinstance(rebuilt, QuantizedTensor) and rebuilt.size == 10
+
+
+# ---------------------------------------------------------------------------
+# Block-quantized ring schedules (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+def _quant_ring(mesh8, x, scheme, bidirectional, **kw):
+    return jax.jit(jax.shard_map(
+        lambda s: quantized_ring_all_reduce(
+            s[0], "dev", scheme, bidirectional=bidirectional, **kw
+        )[None],
+        mesh=mesh8, in_specs=P("dev"), out_specs=P("dev"), check_vma=False,
+    ))(jnp.asarray(x))
+
+
+@pytest.mark.parametrize("scheme,bidirectional", [
+    ("int8", False), ("int8", True), ("int4", False), ("int4", True),
+])
+@pytest.mark.parametrize("size", [4096, 1000, 17])
+def test_quantized_ring_close_and_identical_across_ranks(
+    mesh8, scheme, bidirectional, size
+):
+    """The quantized ring's mean stays within the scheme's quantization
+    noise of the exact mean, and — because the all-gather half circulates
+    each owner's wire bytes unchanged — every rank's copy is BIT-IDENTICAL
+    (the all-reduce postcondition, which per-hop requantization on the
+    gather path would break). Sizes straddle block (512) and segment
+    boundaries: 1000 and 17 exercise the zero-padded tails."""
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((8, size)).astype(np.float32)
+    got = np.asarray(_quant_ring(mesh8, x, scheme, bidirectional))
+    for r in range(1, 8):
+        np.testing.assert_array_equal(got[r], got[0], err_msg=f"rank {r}")
+    exact = x.mean(axis=0)
+    qmax = get_scheme(scheme).qmax
+    # per-hop error ≤ one quantum of the partial sums (absmax ≤ n·|x|max);
+    # n−1 accumulating hops + the final gather quantization, ÷n for AVG
+    bound = 8 * np.abs(x).max() / qmax
+    assert np.abs(got[0] - exact).max() < bound, (
+        np.abs(got[0] - exact).max(), bound
+    )
+
+
+def test_quantized_ring_pad_never_leaks(mesh8):
+    """Non-multiple-of-block tails: the ring zero-pads up to a multiple of
+    directions·n·block, and those pad lanes must NEVER leak into the
+    dequantized output (ISSUE 9 satellite). An all-ones payload makes any
+    leak visible: a pad lane bleeding into a real lane would pull it off
+    1.0 by a whole quantum, far above the scheme's rounding noise on a
+    constant block (which quantizes EXACTLY: absmax scaling maps the
+    constant to ±qmax)."""
+    for size in (1, 511, 513, 4095, 4097):
+        x = np.ones((8, size), np.float32)
+        for scheme in ("int8", "int4"):
+            got = np.asarray(_quant_ring(mesh8, x, scheme, False))[0]
+            # constant blocks round-trip exactly — any deviation is a leak
+            np.testing.assert_allclose(
+                got, np.ones(size, np.float32), rtol=0, atol=1e-6,
+                err_msg=f"scheme={scheme} size={size}",
+            )
+    # and the v1 quantize_int8 pad (inside _blocked) stays internal too
+    odd = jnp.asarray(np.ones(777, np.float32))
+    np.testing.assert_allclose(
+        np.asarray(dequantize_int8(quantize_int8(odd, seed=5))),
+        np.ones(777, np.float32), rtol=0, atol=1e-6,
+    )
+
+
+def test_quantized_ring_sum_and_deterministic(mesh8):
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((8, 600)).astype(np.float32)
+    got = np.asarray(
+        _quant_ring(mesh8, x, "int8", False, mean=False, stochastic=False)
+    )[0]
+    bound = 8 * 8 * np.abs(x).max() / 127
+    assert np.abs(got - x.sum(axis=0)).max() < bound
+    # deterministic rounding: same input, same bits — the property that
+    # makes an EF run's kill-and-resume bit-identical
+    again = np.asarray(
+        _quant_ring(mesh8, x, "int8", False, mean=False, stochastic=False)
+    )[0]
+    np.testing.assert_array_equal(got, again)
+
+
+def test_quantized_ring_rejects_integer_payloads(mesh8):
+    with pytest.raises(ValueError, match="float"):
+        jax.jit(jax.shard_map(
+            lambda s: quantized_ring_all_reduce(s[0], "dev")[None],
+            mesh=mesh8, in_specs=P("dev"), out_specs=P("dev"), check_vma=False,
+        ))(jnp.zeros((8, 64), jnp.int32))
+
+
+@pytest.mark.parametrize("size", [4096, 4099, 63])
+def test_quantized_reduce_scatter_layout_matches_flat(mesh8, size):
+    """Rank i is left with contiguous segment i (flat_reduce_scatter's
+    contract) and the values track the fp32 reduce-scatter within
+    quantization noise — the shard length matches the unquantized path's
+    exactly, so ZeRO-2's sharded optimizer state fits unchanged."""
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((8, size)).astype(np.float32)
+
+    def rs(s):
+        shard, padded = quantized_flat_reduce_scatter(s[0], "dev", "int8")
+        assert padded == -(-size // 8) * 8  # static: the n-multiple rule
+        return shard[None]
+
+    got = np.asarray(jax.jit(jax.shard_map(
+        rs, mesh=mesh8, in_specs=P("dev"), out_specs=P("dev"), check_vma=False,
+    ))(jnp.asarray(x))).reshape(-1)
+    padded = -(-size // 8) * 8
+    exact = np.zeros(padded, np.float32)
+    exact[:size] = x.mean(axis=0)
+    bound = 8 * np.abs(x).max() / 127
+    assert np.abs(got - exact).max() < bound
+
+
+def test_error_feedback_recovers_sub_quantum_gradients(mesh8):
+    """THE error-feedback property: a persistent gradient component too
+    small for the DETERMINISTIC quantizer (round-to-nearest floors it to
+    zero every hop) is lost forever on its own, but with the residual
+    folded back in it accumulates until it crosses a quantum and the
+    delivered mass catches up (EF-SGD's claim, here pinned on the real
+    ring). The no-EF production path dithers stochastically instead —
+    unbiased in expectation — so the honest contrast is against the same
+    deterministic compressor EF actually corrects."""
+    from dsml_tpu.parallel.bucketing import bucketed_all_reduce
+
+    block = default_qblock()
+    # one large element pins the block scale; the rest sit far below half
+    # a quantum, so round-to-nearest drops them every single step
+    base = np.zeros((8, block), np.float32)
+    base[:, 0] = 1.0
+    small = 0.003  # quantum = 1/127 ≈ 0.00787
+    base[:, 1:] = small
+
+    def sync(stacked, ef_stacked, use_ef):
+        def fn(s, e):
+            tree = {"g": s[0]}
+            if use_ef:
+                out, new_ef = bucketed_all_reduce(
+                    tree, "dev", ReduceOp.AVG, "q8_ring", 4.0,
+                    error_feedback={"g": e[0]},
+                )
+                return out["g"][None], new_ef["g"][None]
+            out = quantized_ring_all_reduce(s[0], "dev", "int8", stochastic=False)
+            return out[None], e
+
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh8, in_specs=(P("dev"), P("dev")),
+            out_specs=(P("dev"), P("dev")), check_vma=False,
+        ))(stacked, ef_stacked)
+
+    steps = 10
+    for use_ef in (False, True):
+        ef = jnp.zeros((8, block), jnp.float32)
+        delivered = np.zeros(block, np.float64)
+        for _ in range(steps):
+            out, ef = sync(jnp.asarray(base), ef, use_ef)
+            delivered += np.asarray(out)[0]
+        want = steps * small
+        got_small = delivered[1:].mean()
+        if use_ef:
+            # delivered mass within one quantum of the true total
+            assert abs(got_small - want) < 1.5 / 127, (got_small, want)
+        else:
+            # deterministic rounding without EF: sub-quantum mass vanishes
+            assert got_small < want / 10, (got_small, want)
+
+
+def test_wire_bytes_reduction_at_least_2x():
+    """The acceptance bar's counting argument: at equal payload the
+    quantized ring ships ≥2× fewer bytes than the fp32 ring (int8 ≈4×,
+    int4 ≈8× — bits/8 + 4/block per element vs 4)."""
+    n_elems = 1 << 20
+    fp32 = ring_wire_bytes(n_elems, 8)
+    for scheme, floor in (("int8", 3.5), ("int4", 7.0)):
+        for bidir in (False, True):
+            q = quantized_ring_wire_bytes(n_elems, 8, scheme, bidir)
+            assert fp32 / q >= floor >= 2.0, (scheme, bidir, fp32 / q)
+    assert ring_wire_bytes(n_elems, 1) == 0
+    assert quantized_ring_wire_bytes(n_elems, 1) == 0
+
+
+def test_pack_int4_bit_identical_to_gpt2_kv_cache():
+    """The shared nibble helpers reproduce the ORIGINAL GPT-2 KV-cache
+    packing bit-for-bit (ISSUE 9 satellite: one helper, two callers). The
+    reference implementation here is the pre-unification inline code,
+    copied verbatim."""
+    rng = np.random.default_rng(7)
+    x32 = jnp.asarray(rng.standard_normal((2, 3, 5, 16)) * 2.0, jnp.float32)
+    a = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    s = jnp.where(a > 0, a / 7.0, 1.0)
+    # --- the old gpt2._kv_quantize int4 body, verbatim ---
+    q_old = jnp.clip(jnp.round(x32 / s), -7, 7).astype(jnp.int32) + 8
+    half = q_old.shape[-1] // 2
+    packed_old = (q_old[..., :half] << 4 | q_old[..., half:]).astype(jnp.uint8)
+    # --- the old gpt2._unpack_int4 body, verbatim ---
+    hi_old = (packed_old >> 4).astype(jnp.int8) - 8
+    lo_old = (packed_old & 0xF).astype(jnp.int8) - 8
+    unpacked_old = jnp.concatenate([hi_old, lo_old], axis=-1)
+
+    packed_new = pack_int4(jnp.clip(jnp.round(x32 / s), -7, 7))
+    np.testing.assert_array_equal(np.asarray(packed_new), np.asarray(packed_old))
+    np.testing.assert_array_equal(
+        np.asarray(unpack_int4(packed_new)), np.asarray(unpacked_old)
+    )
+    # and the live model path still produces the same packed cache
+    import dataclasses
+
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), kv_quant="int4"))
+    kq, ks = model._kv_quantize(x32)
+    np.testing.assert_array_equal(np.asarray(kq), np.asarray(packed_old))
+    np.testing.assert_array_equal(
+        np.asarray(model._unpack_int4(kq)), np.asarray(unpacked_old)
+    )
+
+
+def test_pack_int4_rejects_odd_axis():
+    with pytest.raises(ValueError, match="even"):
+        pack_int4(jnp.zeros((4, 3), jnp.int32))
+
+
+def test_quantize_roundtrip_error_bounded_by_quantum():
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.standard_normal(1000) * 3.0, jnp.float32)
+    for scheme in ("int8", "int4"):
+        back = quantize_roundtrip(x, scheme)
+        qmax = get_scheme(scheme).qmax
+        # deterministic nearest rounding: error ≤ half a quantum per block
+        assert np.abs(np.asarray(back - x)).max() <= (
+            float(jnp.abs(x).max()) / qmax / 2 + 1e-6
+        )
+
+
+def test_env_knobs_qblock_and_quant(monkeypatch):
+    monkeypatch.setenv("DSML_QBLOCK", "256")
+    assert default_qblock() == 256
+    assert get_scheme("int8").block == 256
+    for bad in ("0", "-4", "511", "nope"):
+        monkeypatch.setenv("DSML_QBLOCK", bad)
+        assert default_qblock() == 512
+    monkeypatch.delenv("DSML_QBLOCK", raising=False)
+
+    monkeypatch.delenv("DSML_QUANT", raising=False)
+    assert quant_algorithm_for("float32") == "q8_ring2"  # documented default
+    monkeypatch.setenv("DSML_QUANT", "int4:ring")
+    assert quant_algorithm_for("float32") == "q4_ring"
+    monkeypatch.setenv("DSML_QUANT", "none")
+    assert quant_algorithm_for("float32") == "ring2"
+    monkeypatch.setenv("DSML_QUANT", "float32=int8:ring,bfloat16=int4:ring2")
+    assert quant_algorithm_for("float32") == "q8_ring"
+    assert quant_algorithm_for(jnp.bfloat16) == "q4_ring2"
+    monkeypatch.setenv("DSML_QUANT", "bfloat16=int4,default=int8:ring2")
+    assert quant_algorithm_for("float64") == "q8_ring2"
+    monkeypatch.setenv("DSML_QUANT", "garbage:value")
+    assert quant_algorithm_for("float32") == "q8_ring2"  # loud fallback > crash
+
+
+def test_get_scheme_validation():
+    with pytest.raises(ValueError, match="unknown quant scheme"):
+        get_scheme("int2")
+    with pytest.raises(ValueError, match="even"):
+        get_scheme("int4", block=3)
+    sch = get_scheme("int8", block=128)
+    assert (sch.bits, sch.qmax, sch.block) == (8, 127, 128)
+    assert sch.wire_bytes_per_block == 128 + 4
+    assert get_scheme(sch) is sch
 
 
 @pytest.mark.parametrize("grid", [(2, 4), (4, 2)])
