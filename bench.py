@@ -2499,6 +2499,247 @@ def _migration_main() -> None:
     print(json.dumps(out))
 
 
+def bench_serving_fleet() -> dict:
+    """Disaggregated-serving section (docs/SERVING.md): the prefill/decode
+    fleet vs N independent monolithic batchers at EQUAL chip count, under
+    shared Poisson + bursty arrival schedules — p50/p99 TTFT, per-token
+    latency (TPOT + decode inter-emission gap), aggregate tokens/sec, and
+    goodput-per-chip from the obs registry. The headline is burst
+    ISOLATION: a burst of long prompts inflates the monolithic pool's
+    decode p99 (prefill chunks share every decode tick) while the
+    disaggregated decode workers' cadence stays flat. Virtual-8 CPU
+    subprocess (same pattern as chaos/migration): the latency RATIOS and
+    the isolation verdict are the signal, absolute walls are CPU."""
+    code = "import bench; bench._serving_fleet_main()"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, cwd=".",
+            timeout=max(min(600.0, _budget_left()), 120.0),
+        )
+        if proc.returncode != 0 or not proc.stdout.strip():
+            return {
+                "serving_fleet_error": (
+                    f"rc={proc.returncode}; stderr tail: {proc.stderr[-300:]}"
+                )
+            }
+        res = json.loads(proc.stdout.strip().splitlines()[-1])
+        out = {f"serving_fleet_{k}": v for k, v in res.items()}
+        out["serving_fleet_note"] = (
+            "virtual-8 CPU, single-threaded tick loop: worker dispatches "
+            "serialize into one wall clock, which UNDERSTATES isolation — "
+            "a real fleet runs workers on their own chips/hosts. Shared "
+            "arrival timestamps across variants; equal worker count "
+            "(chips) per variant"
+        )
+        return out
+    except Exception as e:  # never fail the bench on the secondary section
+        return {"serving_fleet_error": repr(e)[:200]}
+
+
+def _serving_fleet_main() -> None:
+    """Subprocess entry for :func:`bench_serving_fleet`: forces the
+    virtual-8 CPU mesh, drives the disaggregated fleet and the monolithic
+    pool through IDENTICAL arrival schedules, prints one JSON line.
+    ``DSML_SERVING_FLEET_TINY=1`` shrinks the workload for CI smoke."""
+    import numpy as np
+
+    from dsml_tpu.utils.platform import configure_platform
+
+    configure_platform("cpu", 8)
+    from dsml_tpu import obs
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.serving import ContinuousBatcher, build_fleet
+
+    tiny = os.environ.get("DSML_SERVING_FLEET_TINY", "").lower() not in (
+        "", "0", "false", "off"
+    )
+    cfg = GPT2Config(vocab_size=256, max_seq=256, n_layer=2, n_head=4,
+                     d_model=64, d_ff=128)
+    model = GPT2(cfg)
+    params = model.init(0)
+    obs.enable(forensics=False)
+    reg = obs.get_registry()
+
+    # equal chip count: 4 workers per variant — disaggregated splits them
+    # 2 prefill + 2 decode, the baseline runs 4 monolithic batchers
+    n_prefill, n_decode, chips = 2, 2, 4
+    n_slots, chunk = 4, 32
+    if tiny:
+        n_poisson, rate_hz = 12, 8.0
+        n_bg, bg_dt, burst_sizes = 12, 0.05, (5,)
+    else:
+        n_poisson, rate_hz = 32, 8.0
+        n_bg, bg_dt, burst_sizes = 28, 0.05, (7, 7)
+
+    rng = np.random.default_rng(0)
+
+    def prompt(lo, hi):
+        return rng.integers(
+            0, cfg.vocab_size, (int(rng.integers(lo, hi)),)
+        ).astype(np.int32)
+
+    # shared schedules: (arrival_s, prompt, max_new) — FIXED timestamps so
+    # every variant faces the identical offered load (the bench_serving
+    # lesson: letting each scheduler's tick time reshape arrivals compares
+    # mismatched workloads)
+    poisson, t = [], 0.0
+    for _ in range(n_poisson):
+        t += float(rng.exponential(1.0 / rate_hz))
+        lo, hi = (8, 25) if rng.random() < 0.7 else (96, 161)
+        poisson.append((t, prompt(lo, hi), int(rng.integers(8, 17))))
+    # bursty: a steady short-prompt decode stream + bursts of LONG prompts
+    # (the head-of-line shape disaggregation exists for)
+    bursty = [(0.05 + i * bg_dt, prompt(8, 25), 12) for i in range(n_bg)]
+    for j, size in enumerate(burst_sizes):
+        bursty += [(0.4 + 0.5 * j, prompt(128, 193), 8) for _ in range(size)]
+    bursty.sort(key=lambda a: a[0])
+
+    def tokens_total():
+        return sum(r["value"] for r in reg.collect()
+                   if r["name"] == "serving_tokens_total")
+
+    class MonoPool:
+        """N independent monolithic batchers behind least-loaded dispatch
+        — the equal-chip baseline (what PRs 6/7 shipped, horizontally)."""
+
+        def __init__(self, n):
+            self.workers = [
+                ContinuousBatcher(
+                    model, params, n_slots=n_slots,
+                    prompt_buckets=(32, 64, 128, 256), prefill_chunk=chunk,
+                )
+                for _ in range(n)
+            ]
+            for i, w in enumerate(self.workers):
+                w.obs_replica = str(i)
+            self.samples, self._out = [], 0
+
+        def submit(self, p, max_new):
+            w = min(self.workers,
+                    key=lambda b: b.n_queued + b.n_active + b.n_pending)
+            w.submit(p, max_new)
+            self._out += 1
+
+        def tick(self):
+            for w in self.workers:
+                if w.n_active or w.n_queued or w.n_pending:
+                    w.step()
+                    for req in w.collect_requests().values():
+                        self._out -= 1
+                        ttft = req.first_token_at - req.submitted_at
+                        tpot = (
+                            (req.finished_at - req.first_token_at)
+                            / (len(req.tokens) - 1)
+                            if len(req.tokens) > 1 else None
+                        )
+                        self.samples.append(
+                            (ttft, tpot, req.finished_at - req.submitted_at)
+                        )
+
+        @property
+        def outstanding(self):
+            return self._out
+
+        def gaps(self):
+            return [g for w in self.workers for g in w._gaps]
+
+        def reset(self):
+            self.samples.clear()
+            for w in self.workers:
+                w.reset_latency_stats()
+
+    class Disagg:
+        def __init__(self):
+            self.router = build_fleet(
+                model, params, n_prefill=n_prefill, n_decode=n_decode,
+                prefill_chunk=chunk, n_slots=n_slots,
+            )
+
+        def submit(self, p, max_new):
+            self.router.submit(p, max_new)
+
+        def tick(self):
+            self.router.tick()
+
+        @property
+        def outstanding(self):
+            return self.router.outstanding
+
+        @property
+        def samples(self):
+            return self.router.latency_samples
+
+        def gaps(self):
+            return self.router.decode_gaps()
+
+        def reset(self):
+            self.router.reset_latency_stats()
+
+    def drive(system, schedule):
+        """Wall-clock replay of one arrival schedule; returns (wall s,
+        tokens emitted per the obs registry)."""
+        tok0 = tokens_total()
+        t0 = time.monotonic()
+        i, n = 0, len(schedule)
+        while i < n or system.outstanding:
+            now = time.monotonic() - t0
+            while i < n and schedule[i][0] <= now:
+                system.submit(schedule[i][1], schedule[i][2])
+                i += 1
+            if i < n and not system.outstanding:
+                time.sleep(max(schedule[i][0] - (time.monotonic() - t0), 0.0))
+                continue
+            system.tick()
+        return time.monotonic() - t0, tokens_total() - tok0
+
+    def pct(vals, q):
+        return round(float(np.percentile(np.asarray(vals), q)) * 1e3, 2)
+
+    out = {
+        "chips": chips, "prefill_workers": n_prefill,
+        "decode_workers": n_decode, "mono_workers": chips,
+        "slots": n_slots, "chunk": chunk, "tiny": int(tiny),
+        "poisson_requests": n_poisson, "bursty_requests": len(bursty),
+    }
+    systems = {"disagg": Disagg(), "mono": MonoPool(chips)}
+    for name, system in systems.items():
+        # warm every program the timed runs can hit (multi-chunk prefill,
+        # decode, inserts) on THIS instance — its jits are per-closure
+        system.submit(prompt(8, 9), 3)
+        system.submit(prompt(90, 91), 3)
+        while system.outstanding:
+            system.tick()
+        system.reset()
+        for wl, schedule in (("poisson", poisson), ("bursty", bursty)):
+            wall, toks = drive(system, schedule)
+            samples = list(system.samples)
+            ttft = [s[0] for s in samples]
+            tpot = [s[1] for s in samples if s[1] is not None]
+            gaps = system.gaps()
+            row = f"{wl}_{name}"
+            out[f"{row}_tokens_per_sec"] = round(toks / wall, 1)
+            out[f"{row}_goodput_per_chip"] = round(toks / wall / chips, 2)
+            out[f"{row}_ttft_p50_ms"] = pct(ttft, 50)
+            out[f"{row}_ttft_p99_ms"] = pct(ttft, 99)
+            out[f"{row}_tpot_p50_ms"] = pct(tpot, 50)
+            out[f"{row}_tpot_p99_ms"] = pct(tpot, 99)
+            out[f"{row}_decode_gap_p50_ms"] = pct(gaps, 50)
+            out[f"{row}_decode_gap_p99_ms"] = pct(gaps, 99)
+            system.reset()
+    out["poisson_throughput_ratio"] = round(
+        out["poisson_disagg_tokens_per_sec"]
+        / out["poisson_mono_tokens_per_sec"], 3,
+    )
+    # the headline: decode p99 per-token latency under prompt bursts —
+    # monolithic pays prefill chunks inside decode ticks, the fleet doesn't
+    out["burst_isolation_speedup"] = round(
+        out["bursty_mono_decode_gap_p99_ms"]
+        / max(out["bursty_disagg_decode_gap_p99_ms"], 1e-6), 2,
+    )
+    print(json.dumps(out))
+
+
 def bench_cluster() -> dict:
     """Cluster-observability section (``docs/OBSERVABILITY.md`` § Cluster):
 
@@ -3031,6 +3272,8 @@ _SECTIONS = {
     "obs": bench_obs,
     "forensics": bench_forensics,
     "chaos": bench_chaos,  # virtual-8 kill/restore schedules; no TPU rows
+    "serving_fleet": bench_serving_fleet,  # disaggregated prefill/decode
+    #                                        A/B vs monolithic; virtual-8
     "cluster": bench_cluster,  # aggregation-plane overhead + regress gate
     "migration": bench_migration,  # P2P shard-motion MB/s + recovery split
 }
@@ -3362,6 +3605,14 @@ def main() -> None:
             extras.update(bench_quant_sweep())
         except Exception as e:
             errors["quant_sweep"] = repr(e)[:300]
+        _bump_progress()
+    # disaggregated serving fleet A/B (virtual-8 subprocess): the burst
+    # isolation + throughput-parity verdicts, budget-gated like the sweeps
+    if not _skip_for_budget(extras, "serving_fleet", 300):
+        try:
+            extras.update(bench_serving_fleet())
+        except Exception as e:
+            errors["serving_fleet"] = repr(e)[:300]
         _bump_progress()
     _emit_final(extras, errors, no_tpu_signal, tpu_unreachable)
 

@@ -42,7 +42,7 @@ _compat.install()
 # Lazy subpackage access keeps the heavy subpackages (models, comm, …) out
 # of the import path until used.
 _SUBPACKAGES = ("ops", "parallel", "models", "comm", "runtime", "utils", "cli",
-                "checkpoint", "obs")
+                "checkpoint", "obs", "serving")
 
 
 def __getattr__(name):

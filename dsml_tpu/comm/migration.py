@@ -196,6 +196,20 @@ class StateDonor:
     def keys(self) -> list[str]:
         return sorted(self._arrays)
 
+    def unregister(self, prefix: str) -> int:
+        """Drop every registered array at ``prefix`` or under
+        ``prefix/...``; returns how many were dropped. The serving KV
+        handoff registers per-request transients
+        (``serving.handoff.register_with_donor``) — without release, a
+        long-lived prefill host would grow its donor table one request at
+        a time. Elastic-migration state is re-registered per step and
+        never needs this."""
+        doomed = [k for k in self._arrays
+                  if k == prefix or k.startswith(prefix + "/")]
+        for k in doomed:
+            del self._arrays[k]
+        return len(doomed)
+
     # -- piece serving -----------------------------------------------------
 
     def plan(self, keys: list[str]) -> dict:

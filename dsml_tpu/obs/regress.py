@@ -173,13 +173,22 @@ _NOT_A_METRIC = (
     # are analytic constants. The grid's quant `_ms` cells stay gated
     # down-good via the `_ms` suffix rule below.
     "parity", "_reduction", "_tolerance",
+    # serving_fleet section: worker/slot/chunk counts are configuration,
+    # not measurements
+    "_workers", "_slots", "_chunk",
 )
 _HIGHER_BETTER = (
     "samples_per_sec", "tokens_per_sec", "tokens_per_s", "goodput",
     "accuracy", "mfu", "speedup", "coverage_pct",
 )
 _LOWER_BETTER_SUFFIX = ("_ms", "_s", "_sec", "_pct", "_ppl")
-_LOWER_BETTER_CONTAINS = ("loss", "overhead", "stall", "latency")
+# "ttft"/"tpot": the serving_fleet section's time-to-first-token and
+# per-token-latency rows gate down-good (their `_ms` suffix already says
+# so; the explicit tokens make the intent survive a unit rename), while
+# `goodput_per_chip`/`tokens_per_sec` ride the up-good table above and
+# `burst_isolation_speedup` the "speedup" rule.
+_LOWER_BETTER_CONTAINS = ("loss", "overhead", "stall", "latency", "ttft",
+                          "tpot")
 
 
 def metric_direction(name: str) -> str | None:

@@ -224,6 +224,47 @@ def ring_wire_bytes(
     return 2 * (n_ranks - 1) * (padded // n_ranks) * itemsize
 
 
+@functools.lru_cache(maxsize=8)
+def _measured_alpha_beta(path: str) -> tuple[float, float] | None:
+    """(α ms/round, β ms/byte) solved from a calibrated collective profile
+    (``obs/regress.py --profile`` output, ``DSML_COLLECTIVE_PROFILE``):
+    the measured ring and naive p50 at one (payload, device count) give
+    two equations in the two alpha-beta unknowns —
+
+        naive = α + (n−1)·S·β          (one round, n−1 shards received)
+        ring  = 2(n−1)·α + 2·S·β       (2(n−1) rounds, ~2S bytes)
+
+    Returns None (→ the analytic default) when the profile is missing any
+    constant, is malformed, or solves to a non-physical α/β ≤ 0 (e.g. a
+    CPU-fallback capture where the "wire" costs nothing) — a bad profile
+    must degrade selection to the prior, never crash a trace."""
+    import json
+
+    try:
+        with open(path) as f:
+            constants = json.load(f)["constants"]
+
+        def med(name: str) -> float:
+            entry = constants[name]
+            return float(entry["median"] if "median" in entry
+                         else entry["fresh"])
+
+        naive_ms = med("allreduce_naive_p50_ms")
+        ring_ms = med("allreduce_ring_p50_ms")
+        payload_b = med("allreduce_payload_mb") * (1 << 20)
+        n = int(med("allreduce_devices"))
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    denom = payload_b * (2 * (n - 1) ** 2 - 2)
+    if n < 2 or denom <= 0:
+        return None
+    beta = (2 * (n - 1) * naive_ms - ring_ms) / denom
+    alpha = naive_ms - (n - 1) * payload_b * beta
+    if alpha <= 0 or beta <= 0:
+        return None
+    return alpha, beta
+
+
 def auto_all_reduce_algorithm(nbytes: int, n_devices: int, latency_bytes: int = 32768) -> str:
     """Payload-aware algorithm selection (the Blink/TACOS §6 Communication
     literature point — SURVEY.md §2.4: pick the collective schedule by where
@@ -240,9 +281,27 @@ def auto_all_reduce_algorithm(nbytes: int, n_devices: int, latency_bytes: int = 
     n ≤ 3 the ring's extra rounds can never pay for its ≤ 0 byte savings,
     so naive always wins). Both inputs are static at trace time, so the
     choice costs nothing at runtime.
+
+    With ``DSML_COLLECTIVE_PROFILE=<path>`` pointing at a calibrated
+    profile (the ``collective_profile.json`` that ``obs/regress.py
+    --profile`` exports from bench history), α and β come from MEASURED
+    ring/naive latencies instead of the ``latency_bytes`` prior, and the
+    choice compares the two predicted costs directly — the first
+    calibration step toward the ROADMAP's cost-model planner. A missing or
+    malformed profile silently keeps the analytic default.
     """
     if n_devices <= 3:
         return "naive"
+    import os
+
+    profile = os.environ.get("DSML_COLLECTIVE_PROFILE")
+    if profile:
+        ab = _measured_alpha_beta(profile)
+        if ab is not None:
+            alpha, beta = ab
+            naive_ms = alpha + (n_devices - 1) * nbytes * beta
+            ring_ms = 2 * (n_devices - 1) * alpha + 2 * nbytes * beta
+            return "naive" if naive_ms <= ring_ms else "ring"
     crossover = latency_bytes * (2 * n_devices - 3) / (n_devices - 3)
     return "naive" if nbytes <= crossover else "ring"
 

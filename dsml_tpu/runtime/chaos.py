@@ -53,6 +53,7 @@ __all__ = [
     "config_from_env",
     "run_chaos_training",
     "run_chaos_serving",
+    "run_chaos_serving_fleet",
     "run_smoke",
     "run_migration_smoke",
 ]
@@ -363,6 +364,42 @@ def run_chaos_serving(fleet, prompts, max_new: int,
     return {"results": {f: results.get(f, []) for f in frids}, "ticks": tick}
 
 
+def run_chaos_serving_fleet(router, prompts, max_new: int,
+                            kill_ticks: dict[int, tuple],
+                            max_ticks: int = 100_000) -> dict:
+    """The disaggregated-fleet variant of :func:`run_chaos_serving`: drive
+    a ``serving.Router`` to drain ``prompts`` while killing WORKERS at the
+    scheduled ticks — ``{tick: ("prefill"|"decode", idx or None=last)}``.
+    A prefill worker killed mid-handoff loses its partial chunk state; the
+    router re-prefills on a survivor (prefill is a pure function of the
+    prompt, so the regenerated KV rows — and therefore the tokens — are
+    identical). Returns results plus the requeue counts the verdict needs
+    to prove the kill actually interrupted work in flight."""
+    frids = [router.submit(p, max_new) for p in prompts]
+    tick = 0
+    while router.outstanding:
+        kill = kill_ticks.get(tick)
+        if kill is not None:
+            kind, idx = kill
+            if kind == "prefill":
+                router.kill_prefill_worker(idx)
+            elif kind == "decode":
+                router.kill_decode_worker(idx)
+            else:
+                raise ValueError(f"unknown worker kind {kind!r}")
+        router.tick()
+        tick += 1
+        if tick > max_ticks:
+            raise RuntimeError(f"serving chaos did not drain in {max_ticks}")
+    results = router.run(max_ticks=1)  # drains the harvested results
+    return {
+        "results": {f: results.get(f, []) for f in frids},
+        "ticks": tick,
+        "requeued_prefill": router.requeued_prefill,
+        "requeued_decode": router.requeued_decode,
+    }
+
+
 # ---------------------------------------------------------------------------
 # smoke: the end-to-end guarantee as an executable check (CI + bench)
 # ---------------------------------------------------------------------------
@@ -477,6 +514,7 @@ def run_smoke(n_steps: int = 24, seeds: tuple = (), checkpoint_every: int = 4,
         report["goodput_floor"] = SMOKE_GOODPUT_FLOOR
         if serving:
             report["serving"] = _serving_smoke(model, cfg, rng)
+            report["serving_fleet"] = _serving_fleet_smoke(model, cfg, rng)
     finally:
         if created:
             shutil.rmtree(base, ignore_errors=True)
@@ -516,6 +554,45 @@ def _serving_smoke(model, cfg, rng) -> dict:
         "token_mismatches": token_loss,
         "ticks": out["ticks"],
         "scale_events": len(fleet.scale_events),
+    }
+
+
+def _serving_fleet_smoke(model, cfg, rng) -> dict:
+    """Disaggregated-fleet loss smoke: a 2-prefill / 2-decode fleet loses
+    a PREFILL worker mid-handoff (work in flight — the kill tick lands
+    while chunked prefill is running) and later a decode worker; every
+    interrupted request re-prefills/re-decodes on survivors and the final
+    tokens must equal the single-batcher reference — zero token loss."""
+    from dsml_tpu.serving import ContinuousBatcher, build_fleet
+
+    params = model.init(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, rng.integers(8, 24)).astype(np.int32)
+        for _ in range(6)
+    ]
+    max_new = 6
+    ref = ContinuousBatcher(model, params, n_slots=2)
+    ref_rids = [ref.submit(p, max_new) for p in prompts]
+    ref_tokens = ref.run()
+
+    router = build_fleet(
+        model, params, n_prefill=2, n_decode=2, prefill_chunk=8,
+        n_slots=2, max_queue=8,
+    )
+    out = run_chaos_serving_fleet(
+        router, prompts, max_new,
+        kill_ticks={1: ("prefill", None), 6: ("decode", None)},
+    )
+    token_loss = sum(
+        1 for frid, rrid in zip(sorted(out["results"]), ref_rids)
+        if out["results"][frid] != ref_tokens[rrid]
+    )
+    return {
+        "requests": len(prompts),
+        "token_mismatches": token_loss,
+        "ticks": out["ticks"],
+        "requeued_prefill": out["requeued_prefill"],
+        "requeued_decode": out["requeued_decode"],
     }
 
 
@@ -910,6 +987,23 @@ def verify(report: dict) -> list[str]:
     if srv is not None and srv.get("token_mismatches", 0) > 0:
         bad.append(f"serving: {srv['token_mismatches']} request(s) lost or "
                    "changed tokens across a replica kill")
+    fleet = report.get("serving_fleet")
+    if fleet is not None:
+        if fleet.get("token_mismatches", 0) > 0:
+            bad.append(
+                f"serving_fleet: {fleet['token_mismatches']} request(s) "
+                "lost or changed tokens across worker kills"
+            )
+        if not fleet.get("requeued_prefill"):
+            bad.append(
+                "serving_fleet: the prefill-worker kill interrupted no "
+                "work — the mid-handoff re-prefill path went unexercised"
+            )
+        if not fleet.get("requeued_decode"):
+            bad.append(
+                "serving_fleet: the decode-worker kill interrupted no "
+                "work — the full-pipeline re-run path went unexercised"
+            )
     return bad
 
 

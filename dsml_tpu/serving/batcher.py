@@ -25,6 +25,14 @@ TPU-first:
 Single-device by design (the TP/DP-sharded decode lives in
 ``generate_spmd``); slots × continuous admission is the axis this module
 adds.
+
+This module is also the DECODE WORKER of the disaggregated serving fleet
+(``dsml_tpu.serving.router``): :meth:`ContinuousBatcher.inject` admits a
+request whose prefill already ran on a PREFILL worker — the handed-off KV
+rows scatter into a slot exactly like a local admission's, and the first
+token samples from the handed-off logits with the identical
+(seed, key_rid, step) PRNG fold, so disaggregation never changes tokens
+(pinned in tests).
 """
 
 from __future__ import annotations
@@ -63,6 +71,11 @@ class Request:
     first_token_at: float | None = None
     finished_at: float | None = None
     last_emit_at: float | None = None
+    # sampler identity override: the PRNG key folds (seed, key_rid, step)
+    # instead of the LOCAL rid — how a fleet keeps sampled tokens identical
+    # to a reference batcher whose rids differ from this replica's (the
+    # router stamps its fleet-wide rid here; None = use ``rid``)
+    key_rid: int | None = None
 
 
 def _bucket(n: int, buckets: tuple) -> int:
@@ -254,6 +267,14 @@ class ContinuousBatcher:
         # aggregator instead of one blended stream; a standalone batcher
         # is replica "0". DecodeFleet restamps this at spawn time.
         self.obs_replica = "0"
+        # worker-kind label on every serving metric: fleet merges split
+        # TTFT (prefill-bound) from TPOT (decode-bound) by role. A batcher
+        # is the fleet's decode worker — a standalone batcher does both
+        # jobs but reports as "decode" (docs/OBSERVABILITY.md)
+        self.obs_role = "decode"
+        # handed-off admissions awaiting a free slot: (Request, cache1,
+        # logits row) — prefilled elsewhere, so admission is insert-only
+        self._inject: deque = deque()
         self._queue: deque[Request] = deque()
         self._live: dict[int, Request] = {}  # queued or in a slot
         self._done: dict[int, Request] = {}  # retired, awaiting collect()
@@ -466,6 +487,7 @@ class ContinuousBatcher:
             )
             self._verify = jax.jit(verify_fn, donate_argnums=(1,))
             self._fresh_cache1 = lambda: model.init_cache(1)
+            self._place_cache1 = lambda tree: jax.tree.map(jnp.asarray, tree)
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -554,6 +576,9 @@ class ContinuousBatcher:
             self._fresh_cache1 = lambda: jax.tree.map(
                 lambda a: jax.device_put(a, head_sh), model.init_cache(1)
             )
+            self._place_cache1 = lambda tree: jax.tree.map(
+                lambda a: jax.device_put(jnp.asarray(a), head_sh), tree
+            )
         self._insert = jax.jit(self._insert_fn, donate_argnums=(0,))
 
     @classmethod
@@ -621,7 +646,8 @@ class ContinuousBatcher:
 
     # ---- request interface -----------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens: int) -> int:
+    def submit(self, prompt, max_new_tokens: int,
+               key_rid: int | None = None) -> int:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("empty prompt")
@@ -647,22 +673,95 @@ class ContinuousBatcher:
         if self.max_queue and len(self._queue) >= self.max_queue:
             # shed AFTER validation: a malformed request is the caller's
             # bug (ValueError), a full queue is the deployment's state
-            self._obs.counter(
-                "serving_shed_total",
-                "requests rejected at submit by the queue cap",
-                labels=("replica",),
-            ).inc(replica=self.obs_replica)
-            raise QueueFull(
-                f"admission queue at its cap ({self.max_queue} waiting); "
-                "request shed — retry on another replica or back off"
-            )
+            self._shed()
         rid = self._next_rid
         self._next_rid += 1
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
-                      submitted_at=time.monotonic())
+                      submitted_at=time.monotonic(), key_rid=key_rid)
         self._queue.append(req)
         self._live[rid] = req
         return rid
+
+    def _shed(self) -> None:
+        self._obs.counter(
+            "serving_shed_total",
+            "requests rejected by the queue cap",
+            labels=("replica", "role"),
+        ).inc(replica=self.obs_replica, role=self.obs_role)
+        raise QueueFull(
+            f"admission queue at its cap ({self.max_queue} waiting); "
+            "request shed — retry on another replica or back off"
+        )
+
+    def inject(self, prompt, max_new_tokens: int, cache1, logits_row,
+               key_rid: int | None = None,
+               submitted_at: float | None = None) -> int:
+        """Admit a request whose PREFILL already ran elsewhere — the
+        decode-worker half of the disaggregated fleet's KV handoff
+        (``dsml_tpu.serving.handoff``). ``cache1`` is the 1-row KV cache a
+        ``PrefillWorker`` (or this class's own chunked-prefill path)
+        produced for the whole prompt; ``logits_row`` the next-token
+        logits at the prompt's last position. Admission costs ONE insert
+        scatter (no prefill compute on this worker); the first token
+        samples from ``logits_row`` under the identical
+        (seed, ``key_rid``, step) fold a local admission would use, so
+        tokens are bit-identical to submitting the prompt here (pinned in
+        tests). ``submitted_at`` carries the ORIGINAL submit time so the
+        admission-latency histogram reports true TTFT, queue + prefill +
+        handoff included. Sheds with :class:`QueueFull` at ``max_queue``
+        like :meth:`submit` (the router retries on another replica)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        self.model._check_generate_args(
+            len(prompt), max_new_tokens, self.temperature, self.top_k, self.top_p
+        )
+        cfg = self.model.config
+        if len(cache1) != cfg.n_layer:
+            raise ValueError(
+                f"handoff cache has {len(cache1)} layers, model has "
+                f"{cfg.n_layer}"
+            )
+        k = cache1[0]["k"]
+        if k.shape[0] != 1 or k.shape[2] != cfg.max_seq:
+            raise ValueError(
+                f"handoff cache rows are {tuple(k.shape)}; expected "
+                f"(1, heads, max_seq={cfg.max_seq}, ...) — prefill and "
+                "decode workers must share the model config"
+            )
+        if self.max_queue and len(self._inject) >= self.max_queue:
+            self._shed()
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(
+            rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
+            submitted_at=(time.monotonic() if submitted_at is None
+                          else submitted_at),
+            key_rid=key_rid,
+        )
+        self._live[rid] = req
+        self._inject.append((req, cache1, np.asarray(logits_row).reshape(-1)))
+        return rid
+
+    def _admit_injected(self, emitted: dict) -> None:
+        """Admit handed-off requests into free slots: insert the prefilled
+        rows and run the shared admission epilogue — ONE dispatch, zero
+        prefill compute (an in-process handoff's device rows pass through
+        ``_place_cache1`` untouched, so the host never copies them).
+        Handoffs admit BEFORE queued prompts: they already paid their
+        prefill, so waiting behind local prefill work would squander the
+        disaggregation win."""
+        while self._inject:
+            free = np.flatnonzero(self._slot_rid == -1)
+            if len(free) == 0:
+                return
+            req, cache1, logits_row = self._inject.popleft()
+            slot = int(free[0])
+            self.n_insert_dispatches += 1
+            self._cache = self._insert(
+                self._cache, self._place_cache1(cache1), jnp.int32(slot)
+            )
+            self._finish_admission(req, slot, logits_row, emitted)
 
     def register_prefix(self, tokens) -> None:
         """Precompute and retain the KV rows + next-token logits for a
@@ -726,6 +825,12 @@ class ContinuousBatcher:
         three (``run`` does)."""
         return 0 if self._pending is None else 1
 
+    @property
+    def n_injected(self) -> int:
+        """Handed-off admissions waiting for a free slot (:meth:`inject`)
+        — a fourth drain-loop term alongside queued/active/pending."""
+        return len(self._inject)
+
     # ---- scheduling ------------------------------------------------------------
 
     def _request_key(self, rid: int):
@@ -735,12 +840,19 @@ class ContinuousBatcher:
         on all samplers folding the identical (seed, rid, step) sequence."""
         return jax.random.fold_in(jax.random.PRNGKey(self.seed), rid)
 
+    def _req_key(self, req: Request):
+        """:meth:`_request_key` under the request's SAMPLER identity —
+        ``key_rid`` when the router stamped one (fleet-wide rid), else the
+        local rid. Every sampler site derives through here so a handed-off
+        request's token stream matches the reference batcher's exactly."""
+        return self._request_key(req.rid if req.key_rid is None else req.key_rid)
+
     def _sample(self, logits: np.ndarray, req: Request) -> int:
         if self.temperature <= 0.0:
             return int(np.argmax(logits))
         from dsml_tpu.models.gpt2 import sample_token_logits
 
-        key = jax.random.fold_in(self._request_key(req.rid), len(req.tokens))
+        key = jax.random.fold_in(self._req_key(req), len(req.tokens))
         return int(sample_token_logits(
             jnp.asarray(logits), key, self.temperature, self.top_k, self.top_p
         ))
@@ -761,7 +873,7 @@ class ContinuousBatcher:
         self._slot_rid[slot] = req.rid
         self._pos[slot] = len(req.prompt)
         self._last_tok[slot] = tok
-        self._slot_key[slot] = np.asarray(self._request_key(req.rid))
+        self._slot_key[slot] = np.asarray(self._req_key(req))
 
     def _finish_admission(self, req: Request, slot: int, logits_row, emitted: dict) -> None:
         """THE admission epilogue — shared by whole-prompt, chunked, and
@@ -777,8 +889,9 @@ class ContinuousBatcher:
             admission_ms = (req.first_token_at - req.submitted_at) * 1e3
             self._obs.histogram(
                 "serving_admission_ms", "submit→first-token latency",
-                labels=("replica",),
-            ).observe(admission_ms, replica=self.obs_replica)
+                labels=("replica", "role"),
+            ).observe(admission_ms, replica=self.obs_replica,
+                      role=self.obs_role)
             from dsml_tpu.obs import flight_recorder
 
             flight_recorder.record(
@@ -979,22 +1092,31 @@ class ContinuousBatcher:
             # "should this deployment raise n_slots"
             self._obs.histogram(
                 "serving_slot_occupancy", "active slots / n_slots per tick",
-                labels=("replica",),
+                labels=("replica", "role"),
                 buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
-            ).observe(self.n_active / self.n_slots, replica=self.obs_replica)
+            ).observe(self.n_active / self.n_slots,
+                      replica=self.obs_replica, role=self.obs_role)
             self._obs.gauge(
                 "serving_queue_depth", "requests waiting for a slot",
-                labels=("replica",),
-            ).set(self.n_queued, replica=self.obs_replica)
+                labels=("replica", "role"),
+            ).set(self.n_queued + self.n_injected,
+                  replica=self.obs_replica, role=self.obs_role)
             self._obs.counter(
                 "serving_tokens_total", "tokens emitted",
-                labels=("replica",),
+                labels=("replica", "role"),
             ).inc(sum(len(t) for t in emitted.values()),
-                  replica=self.obs_replica)
+                  replica=self.obs_replica, role=self.obs_role)
         return emitted
 
     def _step_inner(self) -> dict[int, list]:
-        emitted = self._admit_chunked() if self.prefill_chunk else self._admit()
+        emitted: dict[int, list] = {}
+        if self._inject:
+            self._admit_injected(emitted)
+        # handed-off and local admissions touch disjoint rids, so a plain
+        # merge cannot clobber an emission list
+        emitted.update(
+            self._admit_chunked() if self.prefill_chunk else self._admit()
+        )
         active = np.flatnonzero(self._slot_rid >= 0)
         if len(active) == 0:
             return emitted
@@ -1160,6 +1282,8 @@ class ContinuousBatcher:
         starts with)."""
         live = [self._live[rid] for rid in sorted(self._live)]
         self._queue.clear()
+        self._inject.clear()  # handed-off rows die with the replica; the
+        #                       router re-prefills from the prompt
         self._live.clear()
         self._pending = None
         self._slot_rid[:] = -1
@@ -1179,11 +1303,21 @@ class ContinuousBatcher:
         self._done.clear()
         return done
 
+    def collect_requests(self) -> dict[int, Request]:
+        """Like :meth:`collect` but returns the full :class:`Request`
+        objects (tokens AND timing marks) — the router's harvest path: it
+        needs per-request TTFT/TPOT samples for load-aware dispatch, which
+        the token-only view discards. Drained the same way."""
+        done = dict(self._done)
+        self._done.clear()
+        return done
+
     def run(self, max_steps: int = 100_000) -> dict[int, list]:
         """Drain queue + slots; returns {rid: [tokens]} for every request
         retired during (or before) this call."""
         for _ in range(max_steps):
-            if not self._queue and self.n_active == 0 and self.n_pending == 0:
+            if (not self._queue and not self._inject
+                    and self.n_active == 0 and self.n_pending == 0):
                 break
             self.step()
         else:

@@ -1,0 +1,347 @@
+"""Prefill worker: chunked prompt prefill to completion, then hand off.
+
+One half of the disaggregated serving split (docs/SERVING.md). A
+``PrefillWorker`` owns params and ONE jitted chunk program (the same
+``model.prefill_chunk`` path the continuous batcher's chunked admission
+uses — chunk chaining is pinned bit-identical to whole-prompt prefill),
+runs at most one chunk dispatch per scheduler tick (so the router's tick
+time stays bounded — pipelining across workers, not within one), and
+emits a :class:`~dsml_tpu.serving.handoff.Handoff` when a prompt
+completes. It never decodes: a burst of long prompts saturates prefill
+workers while decode workers keep emitting tokens at their steady cadence
+— the interference isolation the fleet A/B measures.
+
+The prefix registry (``register_prefix``) is the batcher's system-prompt
+pattern at the fleet level: the router replicates each registration
+across every prefill worker, so any worker admits a matching prompt by
+copying the master rows and chunk-prefilling only the suffix — admission
+drops from O(L) to O(L − P) fleet-wide.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dsml_tpu.obs import get_registry
+from dsml_tpu.serving.batcher import QueueFull
+from dsml_tpu.serving.handoff import Handoff
+
+__all__ = ["PrefillWorker"]
+
+
+@dataclasses.dataclass
+class _Job:
+    frid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    key_rid: int | None
+    submitted_at: float
+    # prompt tokens this job will actually prefill (longest matching
+    # prefix subtracted at submit time) — summed into the worker's O(1)
+    # running load counter; re-stamped when a new prefix registers
+    eff_tokens: int = 0
+
+
+class PrefillWorker:
+    """Chunked prefill to completion; emits handoffs, never decodes.
+
+    ``submit`` enqueues a prompt (``frid`` is the fleet-wide id the
+    handoff and the sampler identity carry; ``max_queue`` sheds with
+    :class:`QueueFull` like the batcher). ``step()`` runs AT MOST one
+    chunk dispatch and returns every handoff completed this tick
+    (exact-prefix hits complete with zero dispatch and ride along).
+    ``abandon()`` evacuates unfinished jobs for re-prefill on a survivor
+    — a worker loss costs latency, never tokens, because prefill is a
+    pure function of the prompt.
+
+    Load signals for the router: :attr:`queue_tokens` (prompt tokens
+    waiting or mid-flight, prefix savings already subtracted) and
+    :meth:`estimate_ms` (that backlog priced at the measured per-chunk
+    wall EWMA)."""
+
+    def __init__(self, model, params, prefill_chunk: int,
+                 max_queue: int = 0):
+        cfg = model.config
+        if not 0 < prefill_chunk <= cfg.max_seq:
+            raise ValueError(
+                f"prefill_chunk must be in [1, max_seq={cfg.max_seq}], "
+                f"got {prefill_chunk}"
+            )
+        if max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {max_queue}")
+        self.model = model
+        self.params = params
+        self.prefill_chunk = int(prefill_chunk)
+        self.max_queue = int(max_queue)
+        self.obs_replica = "0"
+        self.obs_role = "prefill"
+        self._obs = get_registry()
+        self._queue: deque[_Job] = deque()
+        self._queued_tokens = 0  # running sum of queued jobs' eff_tokens
+        # the in-flight job: (job, accumulating 1-row cache, next start)
+        self._pending: tuple | None = None
+        self._prefixes: list = []  # (tokens, cache1, last_logits) len-desc
+        self._next_frid = 0
+        # measured per-chunk wall EWMA (seconds) — the router's prefill
+        # cost model; seeded by the first real chunk
+        self.chunk_s_ewma: float | None = None
+        self.n_chunk_dispatches = 0
+        self.n_handoffs = 0
+
+        def chunk_fn(p, c, toks, start, last):
+            return model.prefill_chunk(p, c, toks, start, None, last_index=last)
+
+        # one compile serves every chunk (start/last stay traced); the
+        # accumulating cache is donated exactly as the batcher's chunk path
+        self._chunk = jax.jit(chunk_fn, donate_argnums=(1,))
+        self._fresh_cache1 = lambda: model.init_cache(1)
+
+    # ---- request interface -----------------------------------------------
+
+    def _fits(self, prompt_len: int) -> bool:
+        c = self.prefill_chunk
+        return -(-prompt_len // c) * c <= self.model.config.max_seq
+
+    def submit(self, prompt, max_new_tokens: int, frid: int | None = None,
+               key_rid: int | None = None,
+               submitted_at: float | None = None) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        # the decode worker re-validates at inject; checking here too fails
+        # at the FLEET edge instead of after prefill compute was spent
+        self.model._check_generate_args(len(prompt), max_new_tokens, 0.0, 0, 0)
+        if not self._fits(len(prompt)):
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the chunk grid for "
+                f"max_seq={self.model.config.max_seq}"
+            )
+        if self.max_queue and len(self._queue) >= self.max_queue:
+            self._obs.counter(
+                "serving_shed_total", "requests rejected by the queue cap",
+                labels=("replica", "role"),
+            ).inc(replica=self.obs_replica, role=self.obs_role)
+            raise QueueFull(
+                f"prefill queue at its cap ({self.max_queue} waiting)"
+            )
+        if frid is None:
+            frid = self._next_frid
+        self._next_frid = max(self._next_frid, frid + 1)
+        pre = self._match_prefix(prompt) if self._prefixes else None
+        eff = len(prompt) - (len(pre[0]) if pre else 0)
+        self._queue.append(_Job(
+            frid=frid, prompt=prompt, max_new_tokens=int(max_new_tokens),
+            key_rid=key_rid,
+            submitted_at=(time.monotonic() if submitted_at is None
+                          else submitted_at),
+            eff_tokens=eff,
+        ))
+        self._queued_tokens += eff
+        return frid
+
+    def register_prefix(self, tokens) -> None:
+        """Precompute + retain KV rows and next-token logits for a shared
+        prompt head — the batcher's ``register_prefix``, prefill-side.
+        Blocking setup call (runs the prefix's chunked prefill now)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = len(tokens)
+        if n < 1:
+            raise ValueError("empty prefix")
+        if not self._fits(n):
+            raise ValueError(
+                f"prefix length {n} exceeds the chunk grid for max_seq="
+                f"{self.model.config.max_seq}"
+            )
+        c = self.prefill_chunk
+        cache1 = self._fresh_cache1()
+        logits = None
+        for start in range(0, n, c):
+            end = min(start + c, n)
+            padded = np.zeros((1, c), np.int32)
+            padded[0, : end - start] = tokens[start:end]
+            last_local = (n - 1) - start if end >= n else c - 1
+            logits, cache1 = self._chunk(
+                self.params, cache1, jnp.asarray(padded),
+                jnp.int32(start), jnp.int32(last_local),
+            )
+        self._prefixes.append((tokens, cache1, np.asarray(logits[0])))
+        self._prefixes.sort(key=lambda p: -len(p[0]))  # longest match wins
+        # re-stamp queued jobs' effective tokens: the new prefix may cover
+        # prompts submitted before it registered (setup-time cost only)
+        self._queued_tokens = 0
+        for job in self._queue:
+            pre = self._match_prefix(job.prompt)
+            job.eff_tokens = len(job.prompt) - (len(pre[0]) if pre else 0)
+            self._queued_tokens += job.eff_tokens
+
+    def _match_prefix(self, prompt: np.ndarray):
+        L = len(prompt)
+        c = self.prefill_chunk
+        max_seq = self.model.config.max_seq
+        for ptoks, pcache, plogits in self._prefixes:
+            p = len(ptoks)
+            if p > L or not np.array_equal(prompt[:p], ptoks):
+                continue
+            if p < L and p + (-(-(L - p) // c)) * c > max_seq:
+                continue  # padded suffix grid would overrun the cache
+            return ptoks, pcache, plogits
+        return None
+
+    # ---- load signals ----------------------------------------------------
+
+    @property
+    def n_queued(self) -> int:
+        return len(self._queue)
+
+    @property
+    def n_pending(self) -> int:
+        return 0 if self._pending is None else 1
+
+    @property
+    def queue_tokens(self) -> int:
+        """Prompt tokens this worker still has to prefill: queued prompts
+        (longest matching prefix already subtracted — registered prefixes
+        cost zero) plus the in-flight job's remaining tokens. O(1): the
+        queued sum is a running counter (the router's dispatch loop reads
+        this per worker per backlog item per tick)."""
+        total = self._queued_tokens
+        if self._pending is not None:
+            job, _, start = self._pending
+            total += max(len(job.prompt) - start, 0)
+        return total
+
+    def estimate_ms(self, prompt_len: int = 0) -> float:
+        """Estimated wall to drain the current backlog plus a hypothetical
+        ``prompt_len`` prompt — queue depth priced at the measured
+        per-chunk EWMA (one chunk dispatch per tick). Pre-measurement the
+        estimate is 0: the router then spreads by queue depth alone."""
+        if not self.chunk_s_ewma:
+            return 0.0
+        chunks = -(-(self.queue_tokens + prompt_len) // self.prefill_chunk)
+        return chunks * self.chunk_s_ewma * 1e3
+
+    # ---- scheduling ------------------------------------------------------
+
+    def _start(self, job: _Job) -> Handoff | None:
+        """Begin ``job``: an exact prefix hit completes immediately (COPIED
+        master rows — the stored cache must survive for the next match);
+        otherwise stage the pending chunk state (prefix rows copied in as
+        the starting cache when a partial hit applies)."""
+        pre = self._match_prefix(job.prompt) if self._prefixes else None
+        if pre is not None:
+            ptoks, pcache, plogits = pre
+            if len(ptoks) == len(job.prompt):
+                self.n_handoffs += 1
+                return Handoff(
+                    frid=job.frid, prompt=job.prompt,
+                    max_new_tokens=job.max_new_tokens,
+                    prefill_len=len(job.prompt),
+                    cache1=jax.tree.map(jnp.copy, pcache),
+                    logits=np.asarray(plogits),
+                    submitted_at=job.submitted_at,
+                    prefill_done_at=time.monotonic(),
+                    key_rid=job.key_rid,
+                )
+            self._pending = (job, jax.tree.map(jnp.copy, pcache), len(ptoks))
+            return None
+        self._pending = (job, self._fresh_cache1(), 0)
+        return None
+
+    def _advance(self) -> Handoff | None:
+        """Run ONE chunk of the in-flight job; returns its handoff when
+        this chunk completed the prompt."""
+        job, cache1, start = self._pending
+        c = self.prefill_chunk
+        L = len(job.prompt)
+        end = min(start + c, L)
+        padded = np.zeros((1, c), np.int32)
+        padded[0, : end - start] = job.prompt[start:end]
+        is_last = end >= L
+        last_local = (L - 1) - start if is_last else c - 1
+        t0 = time.monotonic()
+        logits, cache1 = self._chunk(
+            self.params, cache1, jnp.asarray(padded),
+            jnp.int32(start), jnp.int32(last_local),
+        )
+        logits_host = np.asarray(logits[0])  # forces the dispatch to finish
+        wall = time.monotonic() - t0
+        self.n_chunk_dispatches += 1
+        self.chunk_s_ewma = (
+            wall if self.chunk_s_ewma is None
+            else 0.8 * self.chunk_s_ewma + 0.2 * wall
+        )
+        if self._obs.enabled:
+            self._obs.histogram(
+                "serving_prefill_chunk_ms", "one prefill chunk dispatch",
+                labels=("replica", "role"),
+            ).observe(wall * 1e3, replica=self.obs_replica,
+                      role=self.obs_role)
+        if not is_last:
+            self._pending = (job, cache1, start + c)
+            return None
+        self._pending = None
+        self.n_handoffs += 1
+        return Handoff(
+            frid=job.frid, prompt=job.prompt,
+            max_new_tokens=job.max_new_tokens, prefill_len=L,
+            cache1=cache1, logits=logits_host,
+            submitted_at=job.submitted_at,
+            prefill_done_at=time.monotonic(),
+            key_rid=job.key_rid,
+        )
+
+    def step(self) -> list[Handoff]:
+        """One scheduler tick: at most ONE chunk dispatch, plus any
+        zero-cost exact-prefix completions reached along the way. Returns
+        the handoffs completed this tick."""
+        out: list[Handoff] = []
+        while True:
+            if self._pending is None:
+                if not self._queue:
+                    break
+                job = self._queue.popleft()
+                self._queued_tokens -= job.eff_tokens
+                h = self._start(job)
+                if h is not None:
+                    out.append(h)  # exact prefix hit: no dispatch spent
+                continue
+            h = self._advance()
+            if h is not None:
+                out.append(h)
+            break  # one chunk dispatch per tick — bounded tick time
+        if self._obs.enabled:
+            self._obs.gauge(
+                "serving_queue_depth", "requests waiting for a slot",
+                labels=("replica", "role"),
+            ).set(self.n_queued + self.n_pending,
+                  replica=self.obs_replica, role=self.obs_role)
+            self._obs.counter(
+                "serving_handoffs_total",
+                "prefilled requests handed to decode workers",
+                labels=("replica", "role"),
+            ).inc(len(out), replica=self.obs_replica, role=self.obs_role)
+        return out
+
+    def abandon(self) -> list[dict]:
+        """Evacuate every unfinished job — queued and mid-chunk — as
+        resubmittable specs (the worker-loss path; a partial cache is
+        dropped, re-prefill reproduces it bit-identically). The worker is
+        reusable afterwards."""
+        jobs = list(self._queue)
+        self._queue.clear()
+        self._queued_tokens = 0
+        if self._pending is not None:
+            jobs.insert(0, self._pending[0])  # it has waited longest
+            self._pending = None
+        return [
+            {"frid": j.frid, "prompt": j.prompt,
+             "max_new_tokens": j.max_new_tokens, "key_rid": j.key_rid,
+             "submitted_at": j.submitted_at}
+            for j in jobs
+        ]

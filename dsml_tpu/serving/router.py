@@ -1,0 +1,523 @@
+"""SLO-aware router over a disaggregated prefill/decode serving fleet.
+
+The front door of the fleet (docs/SERVING.md): N prefill workers and M
+decode workers behind one admission surface. Responsibilities:
+
+- **Admission + shedding** — every request names an :class:`SLOClass`;
+  a class sheds with the batcher's own :class:`QueueFull` when its router
+  backlog hits the class cap or the measured-TTFT estimate exceeds the
+  class budget. Overload is an EXPLICIT signal (``serving_shed_total``
+  with ``role="router"``) raised BEFORE queues collapse — decode p99
+  stays flat while the router turns excess load away (pinned in tests).
+- **Load-aware dispatch** — prompts go to the prefill worker with the
+  cheapest measured backlog (queue tokens priced at the per-chunk wall
+  EWMA); completed handoffs go to the decode worker with the smallest
+  (queue depth, measured TPOT) — queue depth and measured TTFT/TPOT, not
+  round-robin.
+- **Prefix replication** — ``register_prefix`` fans out to every prefill
+  worker, so the system-prompt O(L−P) admission win holds wherever a
+  request lands.
+- **Handoff transport** — in-process object handover by default;
+  ``transport=`` a callable (e.g. ``handoff.frame_transport``) routes
+  every handoff through the CRC-framed wire codec; real cross-host pulls
+  use the donor/migrator stream path (``serving.handoff``).
+- **Failure** — ``kill_prefill_worker`` / ``kill_decode_worker`` are the
+  chaos hooks: unfinished work re-enters the backlog and RE-PREFILLS on
+  survivors. Prefill is a pure function of the prompt and the sampler
+  folds the fleet-wide rid, so a worker loss costs latency, never tokens
+  (``runtime.chaos.run_chaos_serving_fleet`` pins it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+
+import numpy as np
+
+from dsml_tpu.obs import flight_recorder, get_registry
+from dsml_tpu.serving.batcher import ContinuousBatcher, QueueFull
+from dsml_tpu.serving.prefill import PrefillWorker
+from dsml_tpu.utils.logging import get_logger
+
+__all__ = ["Router", "SLOClass", "build_fleet"]
+
+log = get_logger("serving.router")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOClass:
+    """One admission class. ``max_queue`` caps this class's ROUTER backlog
+    (0 = unbounded); ``ttft_budget_ms`` sheds when the measured-load TTFT
+    estimate exceeds it (None = no budget); lower ``priority`` dispatches
+    first when classes compete for prefill capacity."""
+
+    name: str
+    max_queue: int = 0
+    ttft_budget_ms: float | None = None
+    priority: int = 0
+
+
+@dataclasses.dataclass
+class _Spec:
+    prompt: object
+    max_new_tokens: int
+    slo: str
+    submitted_at: float
+
+
+class Router:
+    """See module docstring. ``prefill_workers`` is a list of
+    :class:`PrefillWorker`, ``decode_workers`` a list of
+    :class:`ContinuousBatcher` (the decode role: admission happens via
+    ``inject``, their own submit path stays unused). All workers must
+    share the model config and — for sampled serving — the same
+    ``seed``/``temperature`` as the reference deployment, since the
+    sampler folds (seed, fleet rid, step)."""
+
+    def __init__(self, prefill_workers, decode_workers,
+                 slo_classes=None, transport=None):
+        if not prefill_workers or not decode_workers:
+            raise ValueError("need at least one prefill and one decode worker")
+        self.prefill_workers = list(prefill_workers)
+        self.decode_workers = list(decode_workers)
+        for i, pw in enumerate(self.prefill_workers):
+            pw.obs_replica = str(i)
+        for i, dw in enumerate(self.decode_workers):
+            dw.obs_replica = str(i)
+            dw.obs_role = "decode"
+        classes = list(slo_classes) if slo_classes else [SLOClass("default")]
+        self._classes = {c.name: c for c in classes}
+        if len(self._classes) != len(classes):
+            raise ValueError("duplicate SLO class names")
+        self.transport = transport
+        self._obs = get_registry()
+        self.obs_replica = "router"
+        self.obs_role = "router"
+        self._backlog: dict[str, deque[int]] = {
+            c.name: deque() for c in classes
+        }
+        self._spec: dict[int, _Spec] = {}
+        self._next_frid = 0
+        self._prefill_at: dict[int, PrefillWorker] = {}
+        self._ready: deque = deque()  # handoffs awaiting decode capacity
+        self._local: dict[tuple, int] = {}   # (id(worker), local rid) -> frid
+        self._decode_at: dict[int, tuple] = {}
+        self._prefill_done_at: dict[int, float] = {}
+        self._results: dict[int, list] = {}
+        # measured fleet latencies (seconds; EWMA alpha 0.2): TTFT end to
+        # end, per-token decode latency, and the handoff→first-token wait
+        # that prices the decode half of the admission estimate
+        self.ttft_ewma_s: float | None = None
+        self.tpot_ewma_s: float | None = None
+        self.decode_wait_ewma_s: float | None = None
+        # raw per-request samples (ttft_s, tpot_s or None, e2e_s) for
+        # offline percentiles — the bench/SLO-report path; cleared by
+        # :meth:`reset_latency_stats`
+        self.latency_samples: list[tuple] = []
+        self._tpot_by_worker: dict[int, float] = {}
+        self.shed_counts: dict[str, int] = {c.name: 0 for c in classes}
+        self.requeued_prefill = 0
+        self.requeued_decode = 0
+        self.transport_failures = 0
+        self.n_handoffs_routed = 0
+
+    # ---- admission -------------------------------------------------------
+
+    def register_prefix(self, tokens) -> None:
+        """Replicate a shared prompt head across EVERY prefill worker (the
+        fleet-wide system-prompt pattern): any worker the router picks
+        admits a matching prompt at O(L − P). Blocking setup call."""
+        for pw in self.prefill_workers:
+            pw.register_prefix(tokens)
+
+    def estimate_ttft_ms(self, prompt_len: int) -> float:
+        """Measured-load TTFT estimate for a hypothetical new prompt:
+        un-prefilled tokens ahead of it — router backlog plus the cheapest
+        worker's own queue — priced at the measured per-chunk wall EWMA
+        (spread across the prefill pool), plus the measured
+        handoff→first-token decode wait. Zero until the first measurements
+        land — the class cap (queue depth) carries admission control
+        before the cost model is warm."""
+        worker_ms = min(
+            pw.estimate_ms(prompt_len) for pw in self.prefill_workers
+        )
+        ewmas = [pw.chunk_s_ewma for pw in self.prefill_workers
+                 if pw.chunk_s_ewma]
+        backlog_ms = 0.0
+        if ewmas:
+            backlog_tokens = sum(
+                len(self._spec[f].prompt)
+                for b in self._backlog.values() for f in b
+            )
+            chunk = self.prefill_workers[0].prefill_chunk
+            chunks = -(-backlog_tokens // chunk)
+            backlog_ms = (chunks * (sum(ewmas) / len(ewmas)) * 1e3
+                          / len(self.prefill_workers))
+        decode_ms = (self.decode_wait_ewma_s or 0.0) * 1e3
+        return worker_ms + backlog_ms + decode_ms
+
+    def _shed(self, cls: SLOClass, reason: str) -> None:
+        self.shed_counts[cls.name] += 1
+        self._obs.counter(
+            "serving_shed_total", "requests rejected by the queue cap",
+            labels=("replica", "role"),
+        ).inc(replica=self.obs_replica, role=self.obs_role)
+        if self._obs.enabled:
+            flight_recorder.record(
+                "serving_router_shed", slo=cls.name, reason=reason,
+            )
+        raise QueueFull(
+            f"SLO class {cls.name!r} shed ({reason}); back off or retry a "
+            "lower class"
+        )
+
+    def submit(self, prompt, max_new_tokens: int, slo: str = "default") -> int:
+        cls = self._classes.get(slo)
+        if cls is None:
+            raise ValueError(
+                f"unknown SLO class {slo!r}; declared: {sorted(self._classes)}"
+            )
+        # validate at the fleet edge: a malformed request must fail HERE
+        # (the caller's bug, ValueError) — not inside a later tick's
+        # dispatch, where it would crash unrelated requests' scheduling
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        pw0 = self.prefill_workers[0]
+        pw0.model._check_generate_args(len(prompt), max_new_tokens, 0.0, 0, 0)
+        if not pw0._fits(len(prompt)):
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the chunk grid for "
+                f"max_seq={pw0.model.config.max_seq}"
+            )
+        if cls.max_queue and len(self._backlog[cls.name]) >= cls.max_queue:
+            self._shed(cls, f"backlog at cap {cls.max_queue}")
+        if cls.ttft_budget_ms is not None:
+            est = self.estimate_ttft_ms(len(prompt))
+            if est > cls.ttft_budget_ms:
+                self._shed(
+                    cls, f"estimated TTFT {est:.0f}ms > budget "
+                    f"{cls.ttft_budget_ms:.0f}ms"
+                )
+        frid = self._next_frid
+        self._next_frid += 1
+        self._spec[frid] = _Spec(
+            prompt=prompt, max_new_tokens=int(max_new_tokens), slo=cls.name,
+            submitted_at=time.monotonic(),
+        )
+        self._backlog[cls.name].append(frid)
+        return frid
+
+    @property
+    def outstanding(self) -> int:
+        return len(self._spec)
+
+    # ---- dispatch --------------------------------------------------------
+
+    def _dispatch_prefill(self) -> None:
+        """Drain backlogs (priority order) onto the cheapest prefill
+        worker. A worker at its queue cap is excluded for this tick only;
+        dispatching stops when every worker is capped."""
+        for cls in sorted(self._classes.values(), key=lambda c: c.priority):
+            backlog = self._backlog[cls.name]
+            while backlog:
+                # capacity-check BEFORE submitting: the worker's own
+                # QueueFull path counts a SHED, and a routed request that
+                # merely waits another tick was never shed (single-threaded
+                # scheduler, so the check cannot race the submit)
+                open_pws = [
+                    pw for pw in self.prefill_workers
+                    if not (pw.max_queue and pw.n_queued >= pw.max_queue)
+                ]
+                if not open_pws:
+                    return
+                frid = backlog[0]
+                spec = self._spec[frid]
+                pw = min(
+                    open_pws,
+                    key=lambda w: (w.estimate_ms(len(spec.prompt)),
+                                   w.queue_tokens, w.n_queued),
+                )
+                pw.submit(
+                    spec.prompt, spec.max_new_tokens, frid=frid,
+                    key_rid=frid, submitted_at=spec.submitted_at,
+                )
+                backlog.popleft()
+                self._prefill_at[frid] = pw
+
+    def _route_handoff(self, h) -> bool:
+        """Place one (already-transported) handoff on the decode worker
+        with the smallest (load, measured TPOT); returns False when every
+        worker is at its inject cap (the handoff waits in ``_ready``).
+        Caps are checked before injecting — the worker's own QueueFull
+        path counts a SHED, and a handoff that merely waits another tick
+        was never shed."""
+        order = sorted(
+            self.decode_workers,
+            key=lambda w: (
+                w.n_active + w.n_queued + w.n_pending + w.n_injected,
+                self._tpot_by_worker.get(id(w), 0.0),
+            ),
+        )
+        for dw in order:
+            if dw.max_queue and dw.n_injected >= dw.max_queue:
+                continue
+            lrid = dw.inject(
+                h.prompt, h.max_new_tokens, h.cache1, h.logits,
+                key_rid=h.key_rid, submitted_at=h.submitted_at,
+            )
+            self._local[(id(dw), lrid)] = h.frid
+            self._decode_at[h.frid] = (dw, lrid)
+            self._prefill_done_at[h.frid] = h.prefill_done_at
+            self.n_handoffs_routed += 1
+            return True
+        return False
+
+    def _harvest(self, dw) -> None:
+        for lrid, req in dw.collect_requests().items():
+            frid = self._local.pop((id(dw), lrid), None)
+            if frid is None:
+                continue
+            self._decode_at.pop(frid, None)
+            self._spec.pop(frid, None)
+            self._results[frid] = req.tokens
+            done_at = self._prefill_done_at.pop(frid, None)
+            if req.first_token_at is None:
+                continue
+            ttft = req.first_token_at - req.submitted_at
+            self.ttft_ewma_s = (
+                ttft if self.ttft_ewma_s is None
+                else 0.8 * self.ttft_ewma_s + 0.2 * ttft
+            )
+            tpot = None
+            if len(req.tokens) > 1 and req.finished_at is not None:
+                tpot = (req.finished_at - req.first_token_at) / (
+                    len(req.tokens) - 1
+                )
+            if req.finished_at is not None:
+                self.latency_samples.append(
+                    (ttft, tpot, req.finished_at - req.submitted_at)
+                )
+            if done_at is not None:
+                wait = max(req.first_token_at - done_at, 0.0)
+                self.decode_wait_ewma_s = (
+                    wait if self.decode_wait_ewma_s is None
+                    else 0.8 * self.decode_wait_ewma_s + 0.2 * wait
+                )
+            if tpot is not None:
+                self.tpot_ewma_s = (
+                    tpot if self.tpot_ewma_s is None
+                    else 0.8 * self.tpot_ewma_s + 0.2 * tpot
+                )
+                prev = self._tpot_by_worker.get(id(dw))
+                self._tpot_by_worker[id(dw)] = (
+                    tpot if prev is None else 0.8 * prev + 0.2 * tpot
+                )
+                if self._obs.enabled:
+                    self._obs.histogram(
+                        "serving_tpot_ms", "per-token decode latency",
+                        labels=("replica", "role"),
+                    ).observe(tpot * 1e3, replica=dw.obs_replica,
+                              role=dw.obs_role)
+            if self._obs.enabled:
+                self._obs.histogram(
+                    "serving_ttft_ms", "end-to-end time to first token",
+                    labels=("replica", "role"),
+                ).observe(ttft * 1e3, replica=self.obs_replica,
+                          role=self.obs_role)
+
+    def tick(self) -> None:
+        """One fleet pass: retry waiting handoffs → dispatch backlog →
+        step prefill workers (routing fresh handoffs) → step decode
+        workers → harvest."""
+        while self._ready:
+            if not self._route_handoff(self._ready[0]):
+                break
+            self._ready.popleft()
+        self._dispatch_prefill()
+        for pw in self.prefill_workers:
+            for h in pw.step():
+                self._prefill_at.pop(h.frid, None)
+                if self.transport is not None:
+                    # the wire hop runs ONCE per handoff, here — a handoff
+                    # parked in _ready must not re-pay encode+CRC+decode
+                    # on every placement retry. A FAILED hop (CRC abort,
+                    # dead stream, donor loss) is the documented
+                    # re-prefill case: the handoff is reproducible from
+                    # the prompt, so the request goes back to the backlog
+                    # front instead of crashing the fleet or stranding
+                    try:
+                        h = self.transport(h)
+                    except Exception as e:  # noqa: BLE001 — wire boundary
+                        self.transport_failures += 1
+                        self._respool(h.frid)
+                        log.warning(
+                            "handoff transport failed for frid %d; "
+                            "re-prefilling: %r", h.frid, e,
+                        )
+                        if self._obs.enabled:
+                            flight_recorder.record(
+                                "serving_handoff_transport_failure",
+                                frid=h.frid, error=repr(e)[:120],
+                            )
+                        continue
+                if not self._route_handoff(h):
+                    self._ready.append(h)
+        for dw in self.decode_workers:
+            if dw.n_active or dw.n_queued or dw.n_pending or dw.n_injected:
+                dw.step()
+                self._harvest(dw)
+        if self._obs.enabled:
+            self._obs.gauge(
+                "serving_queue_depth", "requests waiting for a slot",
+                labels=("replica", "role"),
+            ).set(
+                sum(len(b) for b in self._backlog.values()) + len(self._ready),
+                replica=self.obs_replica, role=self.obs_role,
+            )
+
+    def decode_gaps(self) -> list[float]:
+        """All decode workers' inter-emission gap samples (seconds),
+        pooled — with ``decode_quantum=1`` these ARE per-token decode
+        latencies, the burst-isolation headline's raw data: a monolithic
+        batcher's gaps stretch while prefill chunks share its ticks; a
+        disaggregated decode worker's do not."""
+        out: list[float] = []
+        for dw in self.decode_workers:
+            out.extend(dw._gaps)
+        return out
+
+    def reset_latency_stats(self) -> None:
+        self.latency_samples.clear()
+        for dw in self.decode_workers:
+            dw.reset_latency_stats()
+
+    def run(self, max_ticks: int = 100_000) -> dict[int, list]:
+        """Drain everything; returns {frid: [tokens]} for every request
+        finished during (or before) this call."""
+        for _ in range(max_ticks):
+            if not self.outstanding:
+                break
+            self.tick()
+        else:
+            raise RuntimeError(f"fleet did not drain within {max_ticks} ticks")
+        out = dict(self._results)
+        self._results.clear()
+        return out
+
+    # ---- chaos hooks -----------------------------------------------------
+
+    def _respool(self, frid: int) -> None:
+        spec = self._spec.get(frid)
+        if spec is None:
+            return
+        self._backlog[spec.slo].appendleft(frid)  # it has waited longest
+
+    def kill_prefill_worker(self, idx: int | None = None) -> int:
+        """Chaos hook: drop a prefill worker (default: the last). Its
+        unfinished jobs — queued and MID-CHUNK — re-enter the backlog at
+        the front and re-prefill on a survivor; identical rows, identical
+        tokens. Returns the requeue count."""
+        if len(self.prefill_workers) <= 1:
+            raise RuntimeError("cannot kill the last prefill worker")
+        pw = self.prefill_workers.pop(
+            idx if idx is not None else len(self.prefill_workers) - 1
+        )
+        requeued = 0
+        # abandon() lists oldest first; appendleft-ing in REVERSE keeps
+        # the longest-waiting job at the backlog head (the same rule as
+        # kill_decode_worker's)
+        for spec in reversed(pw.abandon()):
+            frid = spec["frid"]
+            self._prefill_at.pop(frid, None)
+            self._respool(frid)
+            requeued += 1
+        self.requeued_prefill += requeued
+        if self._obs.enabled:
+            flight_recorder.record(
+                "serving_prefill_worker_lost", requeued=requeued,
+                survivors=len(self.prefill_workers),
+            )
+        return requeued
+
+    def kill_decode_worker(self, idx: int | None = None) -> int:
+        """Chaos hook: drop a decode worker. Finished-but-uncollected
+        results are harvested first; unfinished requests (injected queue,
+        mid-decode) re-enter the backlog and run the FULL pipeline again —
+        re-prefill on a prefill worker, handoff, decode on a survivor.
+        Greedy decode makes the re-run bit-identical. Returns the requeue
+        count."""
+        if len(self.decode_workers) <= 1:
+            raise RuntimeError("cannot kill the last decode worker")
+        dw = self.decode_workers.pop(
+            idx if idx is not None else len(self.decode_workers) - 1
+        )
+        self._harvest(dw)
+        requeued = 0
+        for req in reversed(dw.abandon()):
+            frid = self._local.pop((id(dw), req.rid), None)
+            if frid is None:
+                continue
+            self._decode_at.pop(frid, None)
+            self._prefill_done_at.pop(frid, None)
+            self._respool(frid)
+            requeued += 1
+        self.requeued_decode += requeued
+        self._tpot_by_worker.pop(id(dw), None)
+        if self._obs.enabled:
+            flight_recorder.record(
+                "serving_decode_worker_lost", requeued=requeued,
+                survivors=len(self.decode_workers),
+            )
+        return requeued
+
+
+def build_fleet(
+    model,
+    params,
+    n_prefill: int = 1,
+    n_decode: int = 1,
+    prefill_chunk: int = 64,
+    slo_classes=None,
+    transport=None,
+    devices=None,
+    prefill_max_queue: int = 0,
+    **decode_kwargs,
+) -> Router:
+    """Assemble a disaggregated fleet: ``n_prefill`` chunked prefill
+    workers + ``n_decode`` decode batchers behind a :class:`Router`.
+    ``devices`` (optional) assigns each decode worker an equal slice via
+    ``ContinuousBatcher.for_devices`` — the fleet's chip budget; prefill
+    workers run on the default device. ``decode_kwargs`` go to each
+    decode batcher (``n_slots``, ``max_queue``, ``temperature``/``seed``,
+    ...). Decode workers keep ``prefill_chunk=0`` — admission arrives
+    prefilled by construction."""
+    prefill_workers = [
+        PrefillWorker(model, params, prefill_chunk,
+                      max_queue=prefill_max_queue)
+        for _ in range(n_prefill)
+    ]
+    if devices is not None:
+        devices = list(devices)
+        per = len(devices) // n_decode
+        if per < 1:
+            raise ValueError(
+                f"{len(devices)} device(s) cannot back {n_decode} decode "
+                "worker(s)"
+            )
+        decode_workers = [
+            ContinuousBatcher.for_devices(
+                model, params, devices[i * per : (i + 1) * per],
+                **decode_kwargs,
+            )
+            for i in range(n_decode)
+        ]
+    else:
+        decode_workers = [
+            ContinuousBatcher(model, params, **decode_kwargs)
+            for _ in range(n_decode)
+        ]
+    return Router(prefill_workers, decode_workers,
+                  slo_classes=slo_classes, transport=transport)

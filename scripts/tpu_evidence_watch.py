@@ -42,6 +42,10 @@ SECTIONS = [
     ("quant_sweep", 900),  # block-quantized collective grid + q8+EF parity
     #                        (virtual-8 CPU subprocess; the wire-reduction
     #                        and parity verdicts are the signal)
+    ("serving_fleet", 900),  # disaggregated prefill/decode A/B vs the
+    #                          monolithic pool (virtual-8 CPU subprocess;
+    #                          burst-isolation + throughput-parity verdicts
+    #                          are the signal)
     ("gpt2_decode", 1200),  # plain + wq8 + kv8 + kv4 variants, 2 compiles each
     ("allreduce", 600),   # incl. the e2e wire-path row (VERDICT r3 item 7)
     ("gpt2_seq8k", 900),

@@ -230,6 +230,40 @@ def test_quant_sweep_section_schema(monkeypatch):
 
 
 @pytest.mark.slow
+def test_serving_fleet_section_schema(monkeypatch):
+    """The BENCH `serving_fleet` section's contract (ISSUE 10 acceptance):
+    the disaggregated fleet and the equal-chip monolithic pool both carry
+    p50/p99 TTFT, per-token latency, and goodput-per-chip under BOTH
+    arrival processes; under the bursty schedule the fleet's decode p99
+    per-token latency beats the monolithic pool's (burst isolation), and
+    under uniform Poisson the fleet keeps ≥ 0.9× the pool's tokens/sec.
+    Runs the TINY A/B (the same one the CI smoke step uses) — slow tier:
+    the subprocess compiles four serving stacks."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    monkeypatch.setenv("DSML_SERVING_FLEET_TINY", "1")
+    rows = bench.bench_serving_fleet()
+
+    assert "serving_fleet_error" not in rows, rows
+    # equal chip count by construction
+    assert (rows["serving_fleet_prefill_workers"]
+            + rows["serving_fleet_decode_workers"]
+            == rows["serving_fleet_mono_workers"]
+            == rows["serving_fleet_chips"])
+    # both variants × both workloads carry the full latency/goodput row
+    for wl in ("poisson", "bursty"):
+        for var in ("disagg", "mono"):
+            for m in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
+                      "tpot_p99_ms", "decode_gap_p99_ms", "tokens_per_sec",
+                      "goodput_per_chip"):
+                assert rows[f"serving_fleet_{wl}_{var}_{m}"] > 0
+    # the acceptance bars: burst isolation + Poisson throughput parity
+    assert rows["serving_fleet_burst_isolation_speedup"] > 1.0
+    assert rows["serving_fleet_poisson_throughput_ratio"] >= 0.9
+
+
+@pytest.mark.slow
 def test_cpu_fallback_emits_under_hung_probe():
     """The capped-preflight path: probe hangs, preflight gives up inside its
     cap, and the CPU fallback still measures mnist and emits — the shape

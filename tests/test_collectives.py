@@ -163,6 +163,70 @@ def test_auto_algorithm_selection_rule():
     assert pick(128 * 1024, 64) == "ring"
 
 
+def test_auto_algorithm_uses_measured_profile(tmp_path, monkeypatch):
+    """ISSUE 10 satellite: DSML_COLLECTIVE_PROFILE feeds MEASURED ring/
+    naive constants into the auto selection — α/β solved from the profile
+    replace the hardcoded latency_bytes crossover. The committed-profile
+    shape (ring barely slower than naive at 1 MB on 8 devices) implies a
+    much larger α than the default prior, so payloads the analytic rule
+    sends to the ring stay on the one-round gather."""
+    import json
+
+    prof = {"schema": "dsml.obs.collective_profile/1", "constants": {
+        "allreduce_naive_p50_ms": {"median": 8.42},
+        "allreduce_ring_p50_ms": {"median": 9.463, "fresh": 9.5},
+        "allreduce_payload_mb": {"median": 1.0},
+        "allreduce_devices": {"median": 8.0},
+    }, "derived": {}}
+    path = tmp_path / "collective_profile.json"
+    path.write_text(json.dumps(prof))
+    monkeypatch.setenv("DSML_COLLECTIVE_PROFILE", str(path))
+    C._measured_alpha_beta.cache_clear()
+    try:
+        alpha, beta = C._measured_alpha_beta(str(path))
+        assert alpha > 0 and beta > 0
+        # measured crossover (α/β ≈ 478 KB) ≫ analytic 85 KiB: 128 KiB
+        # flips from the prior's "ring" to the measured "naive"
+        assert C.auto_all_reduce_algorithm(128 * 1024, 8) == "naive"
+        assert C.auto_all_reduce_algorithm(16 << 20, 8) == "ring"
+        # n ≤ 3 still short-circuits before the profile is consulted
+        assert C.auto_all_reduce_algorithm(1 << 30, 2) == "naive"
+    finally:
+        C._measured_alpha_beta.cache_clear()
+
+
+def test_auto_algorithm_profile_fallbacks(tmp_path, monkeypatch):
+    """A missing, malformed, or non-physical profile silently keeps the
+    analytic crossover — calibration must never crash (or change) a trace
+    it cannot inform."""
+    import json
+
+    # missing file
+    monkeypatch.setenv("DSML_COLLECTIVE_PROFILE", str(tmp_path / "nope.json"))
+    C._measured_alpha_beta.cache_clear()
+    assert C.auto_all_reduce_algorithm(1 << 20, 8) == "ring"
+    # malformed JSON
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json")
+    monkeypatch.setenv("DSML_COLLECTIVE_PROFILE", str(bad))
+    C._measured_alpha_beta.cache_clear()
+    assert C.auto_all_reduce_algorithm(1 << 20, 8) == "ring"
+    assert C.auto_all_reduce_algorithm(1024, 8) == "naive"
+    # non-physical solve (ring faster than one naive round → β ≤ 0)
+    weird = tmp_path / "weird.json"
+    weird.write_text(json.dumps({"constants": {
+        "allreduce_naive_p50_ms": {"median": 10.0},
+        "allreduce_ring_p50_ms": {"median": 200.0},
+        "allreduce_payload_mb": {"median": 1.0},
+        "allreduce_devices": {"median": 8.0},
+    }}))
+    monkeypatch.setenv("DSML_COLLECTIVE_PROFILE", str(weird))
+    C._measured_alpha_beta.cache_clear()
+    assert C._measured_alpha_beta(str(weird)) is None
+    assert C.auto_all_reduce_algorithm(1 << 20, 8) == "ring"
+    C._measured_alpha_beta.cache_clear()
+
+
 def test_auto_matches_exact_both_regimes(mesh8):
     """auto must be numerically exact whichever schedule it picks."""
     for n_elem in (64, 262_144):  # 256 B (naive regime) and 1 MB (ring regime)

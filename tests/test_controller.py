@@ -400,8 +400,9 @@ def test_decode_fleet_metrics_are_labeled_per_replica():
     try:
         reg = obs.get_registry()
         tokens = reg.counter("serving_tokens_total", "tokens emitted",
-                             labels=("replica",))
-        before = {r: tokens.value(replica=r) for r in ("0", "1")}
+                             labels=("replica", "role"))
+        before = {r: tokens.value(replica=r, role="decode")
+                  for r in ("0", "1")}
         fleet = DecodeFleet(
             lambda: ContinuousBatcher(model, params, n_slots=2, max_queue=8),
             min_replicas=2, max_replicas=2, scale_down_idle_ticks=10_000,
@@ -410,13 +411,14 @@ def test_decode_fleet_metrics_are_labeled_per_replica():
         for p in _prompts(cfg, n=6):
             fleet.submit(p, 4)
         fleet.run()
-        emitted = {r: tokens.value(replica=r) - before[r] for r in ("0", "1")}
+        emitted = {r: tokens.value(replica=r, role="decode") - before[r]
+                   for r in ("0", "1")}
         # both replicas worked AND their series are distinguishable
         assert emitted["0"] > 0 and emitted["1"] > 0
         assert emitted["0"] + emitted["1"] == 6 * 4
-        depth = reg.gauge("serving_queue_depth", labels=("replica",))
-        assert depth.value(replica="0") is not None
-        assert depth.value(replica="1") is not None
+        depth = reg.gauge("serving_queue_depth", labels=("replica", "role"))
+        assert depth.value(replica="0", role="decode") is not None
+        assert depth.value(replica="1", role="decode") is not None
     finally:
         if not was:
             obs.disable()

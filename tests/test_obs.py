@@ -637,18 +637,19 @@ def test_serving_admission_and_occupancy_metrics():
         for _ in range(3):
             srv.submit(rng.integers(0, 64, (8,)).astype(np.int32), 4)
         srv.run()
-        # serving metrics carry a replica label (a standalone batcher is
-        # replica "0"; DecodeFleet restamps per spawn)
+        # serving metrics carry replica + role labels (a standalone
+        # batcher is replica "0" role "decode"; DecodeFleet restamps the
+        # replica per spawn, the disaggregated fleet stamps both)
         assert reg.histogram(
-            "serving_admission_ms", labels=("replica",)
-        ).summary(replica="0")["count"] == 3
+            "serving_admission_ms", labels=("replica", "role")
+        ).summary(replica="0", role="decode")["count"] == 3
         assert reg.histogram(
-            "serving_slot_occupancy", labels=("replica",),
+            "serving_slot_occupancy", labels=("replica", "role"),
             buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
-        ).summary(replica="0")["count"] >= 1
+        ).summary(replica="0", role="decode")["count"] >= 1
         assert reg.counter(
-            "serving_tokens_total", labels=("replica",)
-        ).value(replica="0") == 3 * 4
+            "serving_tokens_total", labels=("replica", "role")
+        ).value(replica="0", role="decode") == 3 * 4
     finally:
         if not was:
             reg.disable()

@@ -842,14 +842,14 @@ def test_queue_cap_sheds_explicitly():
         reg = obs.get_registry()
         shed = reg.counter(
             "serving_shed_total",
-            "requests rejected at submit by the queue cap",
-            labels=("replica",),
+            "requests rejected by the queue cap",
+            labels=("replica", "role"),
         )
-        before = shed.value(replica="0")
+        before = shed.value(replica="0", role="decode")
         rids = [srv.submit(p, 3) for p in prompts[:2]]  # queue holds 2
         with pytest.raises(QueueFull, match="cap"):
             srv.submit(prompts[2], 3)
-        assert shed.value(replica="0") - before == 1
+        assert shed.value(replica="0", role="decode") - before == 1
         assert srv.n_queued == 2  # the shed request left no residue
         # draining frees queue space: submit succeeds again afterwards
         out = srv.run()

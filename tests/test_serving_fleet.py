@@ -1,0 +1,448 @@
+"""Disaggregated prefill/decode serving fleet (docs/SERVING.md).
+
+The bar every scheduler change has to clear, fleet edition: splitting
+serving into prefill workers + KV handoff + decode workers is a pure
+throughput/latency optimization — each request's tokens must equal what a
+single ``ContinuousBatcher`` (and therefore plain ``generate``) produces,
+no matter which workers served it, whether its prefix came from the
+replicated registry, whether the handoff crossed the CRC-framed wire or
+the real P2P streams, or whether a worker died mid-flight.
+"""
+
+import numpy as np
+import pytest
+
+from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+from dsml_tpu.serving import (
+    ContinuousBatcher,
+    HandoffIntegrityError,
+    PrefillWorker,
+    QueueFull,
+    Router,
+    SLOClass,
+    build_fleet,
+    decode_handoff,
+    encode_handoff,
+    frame_transport,
+)
+
+
+def _tiny():
+    cfg = GPT2Config.tiny()
+    return GPT2(cfg), cfg
+
+
+def _small():
+    cfg = GPT2Config(vocab_size=64, max_seq=64, n_layer=2, n_head=2,
+                     d_model=32, d_ff=64)
+    return GPT2(cfg), cfg
+
+
+def _prompts(cfg, lengths, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, cfg.vocab_size, (l,)).astype(np.int32)
+            for l in lengths]
+
+
+def _reference_tokens(model, params, prompts, budgets, **batcher_kwargs):
+    ref = ContinuousBatcher(model, params, n_slots=2, **batcher_kwargs)
+    rids = [ref.submit(p, n) for p, n in zip(prompts, budgets)]
+    out = ref.run()
+    return [out[r] for r in rids]
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: disaggregated == monolithic
+# ---------------------------------------------------------------------------
+
+
+def test_disagg_matches_monolithic_greedy():
+    """Mixed prompt lengths (single- and multi-chunk), more requests than
+    decode slots, 2 prefill + 2 decode workers: every request's greedy
+    tokens equal the single-batcher (and hence generate) output."""
+    model, cfg = _tiny()
+    params = model.init(0)
+    prompts = _prompts(cfg, [5, 17, 32, 9, 26, 40])
+    budgets = [5, 3, 6, 5, 3, 4]
+    want = _reference_tokens(model, params, prompts, budgets)
+
+    fleet = build_fleet(model, params, n_prefill=2, n_decode=2,
+                        prefill_chunk=8, n_slots=2)
+    frids = [fleet.submit(p, n) for p, n in zip(prompts, budgets)]
+    out = fleet.run()
+    assert [out[f] for f in frids] == want
+
+
+def test_disagg_matches_monolithic_sampled():
+    """Temperature sampling: the decode worker samples with the fleet-wide
+    rid (``key_rid``) folded into the key, so the sampled stream matches a
+    reference batcher whose local rids coincide — disaggregation changes
+    WHERE sampling happens, never what it draws."""
+    model, cfg = _tiny()
+    params = model.init(4)
+    prompts = _prompts(cfg, [6, 11, 19], seed=4)
+    budgets = [4, 4, 4]
+    want = _reference_tokens(model, params, prompts, budgets,
+                             temperature=0.8, seed=7)
+
+    fleet = build_fleet(model, params, n_prefill=1, n_decode=2,
+                        prefill_chunk=8, n_slots=2, temperature=0.8, seed=7)
+    frids = [fleet.submit(p, n) for p, n in zip(prompts, budgets)]
+    out = fleet.run()
+    assert [out[f] for f in frids] == want
+
+
+def test_disagg_prefix_cache_hit_identity():
+    """The replicated prefix registry: prompts heading with a registered
+    prefix (exact hit and prefix+suffix), plus a non-matching prompt, all
+    produce reference-identical tokens — the O(L−P) admission win is
+    latency-only."""
+    model, cfg = _tiny()
+    params = model.init(2)
+    rng = np.random.default_rng(2)
+    prefix = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    prompts = [
+        np.concatenate([prefix, rng.integers(0, cfg.vocab_size, (7,))
+                        .astype(np.int32)]),
+        prefix.copy(),                       # exact hit: zero prefill work
+        rng.integers(0, cfg.vocab_size, (9,)).astype(np.int32),  # no match
+    ]
+    budgets = [5, 4, 5]
+    want = _reference_tokens(model, params, prompts, budgets)
+
+    fleet = build_fleet(model, params, n_prefill=2, n_decode=1,
+                        prefill_chunk=8, n_slots=2)
+    fleet.register_prefix(prefix)
+    # replication reached every worker
+    assert all(len(pw._prefixes) == 1 for pw in fleet.prefill_workers)
+    frids = [fleet.submit(p, n) for p, n in zip(prompts, budgets)]
+    out = fleet.run()
+    assert [out[f] for f in frids] == want
+    # admission really ran at O(L−P): the suffix prompt paid 1 chunk (7
+    # tokens), the non-match 2 chunks (9 tokens), the exact hit ZERO
+    total_chunks = sum(pw.n_chunk_dispatches for pw in fleet.prefill_workers)
+    assert total_chunks == 3
+
+
+# ---------------------------------------------------------------------------
+# the wire: CRC-framed codec + real P2P streams
+# ---------------------------------------------------------------------------
+
+
+def _one_handoff(model, params, prompt, max_new=4):
+    pw = PrefillWorker(model, params, prefill_chunk=8)
+    pw.submit(prompt, max_new, frid=0, key_rid=0)
+    for _ in range(64):
+        done = pw.step()
+        if done:
+            return done[0]
+    raise AssertionError("prefill did not complete")
+
+
+def test_handoff_codec_round_trip_and_corruption():
+    """encode→decode is bit-exact for every cache leaf + the logits; a
+    single flipped payload byte fails CRC validation loudly (the
+    migration-path contract: corruption never lands in a cache)."""
+    model, cfg = _small()
+    params = model.init(0)
+    h = _one_handoff(model, params, _prompts(cfg, [13], seed=1)[0])
+    frame = encode_handoff(h)
+    back = decode_handoff(frame)
+    assert back.frid == h.frid and back.prefill_len == h.prefill_len
+    np.testing.assert_array_equal(back.prompt, h.prompt)
+    np.testing.assert_array_equal(back.logits, np.asarray(h.logits))
+    for got_l, want_l in zip(back.cache1, h.cache1):
+        assert sorted(got_l) == sorted(want_l)
+        for key in want_l:
+            np.testing.assert_array_equal(got_l[key], np.asarray(want_l[key]))
+    corrupt = bytearray(frame["payload"])
+    corrupt[len(corrupt) // 2] ^= 0xFF
+    with pytest.raises(HandoffIntegrityError, match="CRC32C"):
+        decode_handoff({**frame, "payload": bytes(corrupt)})
+
+
+def test_disagg_through_frame_transport_identity():
+    """Every handoff routed through the CRC-framed byte codec (serialize →
+    validate frames → reconstruct): tokens still match the monolithic
+    reference — the wire hop is invisible to decoding."""
+    model, cfg = _tiny()
+    params = model.init(0)
+    prompts = _prompts(cfg, [5, 17, 26], seed=3)
+    budgets = [5, 4, 3]
+    want = _reference_tokens(model, params, prompts, budgets)
+
+    fleet = build_fleet(model, params, n_prefill=1, n_decode=1,
+                        prefill_chunk=8, n_slots=2,
+                        transport=frame_transport)
+    frids = [fleet.submit(p, n) for p, n in zip(prompts, budgets)]
+    out = fleet.run()
+    assert [out[f] for f in frids] == want
+
+
+def test_cross_worker_handoff_over_real_streams():
+    """Cross-host handoff end to end over the HARDENED stream path: the
+    prefill host registers the handoff with its device server's
+    ``StateDonor``; the decode host pulls it with a ``ShardMigrator`` over
+    real gRPC ``BeginSend``/``StreamSend`` (per-frame CRC32C, resumable
+    offsets) — then injects and decodes reference-identical tokens."""
+    from dsml_tpu.comm.device_server import serve_device
+    from dsml_tpu.comm.migration import MigrationConfig, ShardMigrator
+    from dsml_tpu.serving import fetch_from_migrator, register_with_donor
+
+    model, cfg = _small()
+    params = model.init(0)
+    prompt = _prompts(cfg, [13], seed=5)[0]
+    max_new = 5
+    want = _reference_tokens(model, params, [prompt], [max_new])[0]
+
+    h = _one_handoff(model, params, prompt, max_new)
+    recv = serve_device(211, mem_size=0x400000)
+    donor = serve_device(212, mem_size=0x400000)
+    try:
+        peers = {0: recv.address, 1: donor.address}
+        recv.runtime.configure_peers(peers, 0)
+        donor.runtime.configure_peers(peers, 1)
+        desc = register_with_donor(donor.runtime.donor, h)
+        mig = ShardMigrator(
+            recv.runtime, 0, [(1, donor.address)],
+            config=MigrationConfig(timeout_s=10.0),
+            local_address=recv.address,
+        )
+        pulled = fetch_from_migrator(mig, desc)
+        assert donor.runtime.donor.unregister(desc["prefix"]) > 0
+        mig.close()
+    finally:
+        recv.stop()
+        donor.stop()
+
+    dw = ContinuousBatcher(model, params, n_slots=2)
+    rid = dw.inject(pulled.prompt, pulled.max_new_tokens, pulled.cache1,
+                    pulled.logits, key_rid=pulled.key_rid)
+    out = dw.run()
+    assert out[rid] == want
+
+
+def test_transport_failure_reprefills_without_token_loss():
+    """A FAILED wire hop (CRC abort, dead stream) is the documented
+    re-prefill case: the router respools the request instead of crashing
+    the fleet or stranding it, and the re-run emits identical tokens —
+    handoffs are reproducible from the prompt."""
+    model, cfg = _tiny()
+    params = model.init(0)
+    prompts = _prompts(cfg, [5, 17], seed=13)
+    budgets = [4, 4]
+    want = _reference_tokens(model, params, prompts, budgets)
+
+    calls = {"n": 0}
+
+    def flaky(h):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise HandoffIntegrityError("injected wire corruption")
+        return frame_transport(h)
+
+    fleet = build_fleet(model, params, n_prefill=1, n_decode=1,
+                        prefill_chunk=8, n_slots=2, transport=flaky)
+    frids = [fleet.submit(p, n) for p, n in zip(prompts, budgets)]
+    out = fleet.run()
+    assert fleet.transport_failures == 1
+    assert [out[f] for f in frids] == want
+
+
+# ---------------------------------------------------------------------------
+# router policy: SLO shedding, load awareness
+# ---------------------------------------------------------------------------
+
+
+def test_router_sheds_by_slo_class_before_collapse():
+    """A scripted burst against a capped class: QueueFull fires at the
+    class cap (counted in serving_shed_total{role="router"}), the
+    uncapped class keeps admitting, and every SURVIVING request drains
+    with reference-identical tokens — explicit shed, zero token loss, no
+    queue collapse."""
+    from dsml_tpu import obs
+
+    model, cfg = _tiny()
+    params = model.init(0)
+    prompts = [_prompts(cfg, [9], seed=6)[0]] * 8  # identical prompts —
+    # every survivor must emit the same reference tokens
+    want = _reference_tokens(model, params, prompts[:1], [3])
+
+    fleet = build_fleet(
+        model, params, n_prefill=2, n_decode=1, prefill_chunk=8, n_slots=2,
+        slo_classes=[
+            SLOClass("interactive", max_queue=2, priority=0),
+            SLOClass("batch", priority=1),
+        ],
+    )
+    obs.enable(forensics=False)
+    try:
+        reg = obs.get_registry()
+        shed = reg.counter("serving_shed_total",
+                           "requests rejected by the queue cap",
+                           labels=("replica", "role"))
+        before = shed.value(replica="router", role="router")
+        admitted = []
+        shed_n = 0
+        for p in prompts:  # burst: no ticks between submits
+            try:
+                admitted.append(fleet.submit(p, 3, slo="interactive"))
+            except QueueFull:
+                shed_n += 1
+                # the uncapped class still admits — per-CLASS shedding
+                admitted.append(fleet.submit(p, 3, slo="batch"))
+        assert shed_n > 0
+        assert shed.value(replica="router", role="router") - before == shed_n
+        assert fleet.shed_counts["interactive"] == shed_n
+        out = fleet.run()
+        assert len(out) == len(admitted)  # zero token loss on survivors
+        for frid in admitted:
+            assert out[frid] == want[0]  # identical prompts ⇒ identical tokens
+    finally:
+        obs.disable()
+
+
+def test_router_sheds_on_ttft_budget_once_measured():
+    """The measured-TTFT budget: after a warmup drain calibrates the
+    per-chunk EWMA, a deep backlog prices a new interactive request past
+    its budget → shed at ADMISSION (the p99 protection), while a
+    no-budget class still accepts."""
+    model, cfg = _tiny()
+    params = model.init(0)
+    fleet = build_fleet(
+        model, params, n_prefill=1, n_decode=1, prefill_chunk=8, n_slots=2,
+        slo_classes=[
+            SLOClass("interactive", ttft_budget_ms=0.5, priority=0),
+            SLOClass("batch", priority=1),
+        ],
+    )
+    warm = _prompts(cfg, [17], seed=7)[0]
+    fleet.submit(warm, 2, slo="batch")
+    fleet.run()
+    assert fleet.prefill_workers[0].chunk_s_ewma is not None
+    # pile un-prefilled tokens into the backlog (no ticks): the estimate
+    # must now exceed the half-millisecond budget
+    for p in _prompts(cfg, [64] * 6, seed=8):
+        fleet.submit(p, 2, slo="batch")
+    assert fleet.estimate_ttft_ms(32) > 0.5
+    with pytest.raises(QueueFull, match="interactive"):
+        fleet.submit(warm, 2, slo="interactive")
+    fleet.run()  # the batch class drains normally afterwards
+
+
+def test_unknown_slo_class_rejected():
+    model, _ = _tiny()
+    params = model.init(0)
+    fleet = build_fleet(model, params, prefill_chunk=8, n_slots=2)
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        fleet.submit(np.asarray([1, 2, 3], np.int32), 2, slo="nope")
+
+
+# ---------------------------------------------------------------------------
+# chaos: worker loss mid-flight
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_kill_prefill_worker_mid_handoff_zero_token_loss():
+    """The fleet chaos variant: a prefill worker dies while handoffs are
+    in flight; interrupted requests re-prefill on the survivor and a later
+    decode-worker kill re-runs its requests through the full pipeline —
+    zero token loss, bit-identical output (run_chaos_serving_fleet)."""
+    from dsml_tpu.runtime.chaos import run_chaos_serving_fleet
+
+    model, cfg = _tiny()
+    params = model.init(0)
+    rng = np.random.default_rng(9)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, rng.integers(8, 24)).astype(np.int32)
+        for _ in range(6)
+    ]
+    max_new = 6
+    want = _reference_tokens(model, params, prompts, [max_new] * 6)
+
+    fleet = build_fleet(model, params, n_prefill=2, n_decode=2,
+                        prefill_chunk=8, n_slots=2, max_queue=8)
+    out = run_chaos_serving_fleet(
+        fleet, prompts, max_new,
+        kill_ticks={1: ("prefill", None), 6: ("decode", None)},
+    )
+    assert out["requeued_prefill"] >= 1  # the kill interrupted real work
+    got = [out["results"][f] for f in sorted(out["results"])]
+    assert got == want
+    with pytest.raises(RuntimeError, match="last prefill worker"):
+        fleet.kill_prefill_worker()
+
+
+# ---------------------------------------------------------------------------
+# role-labeled metrics
+# ---------------------------------------------------------------------------
+
+
+def test_role_labels_split_fleet_metrics():
+    """ISSUE 10 satellite: serving metrics carry a role label alongside
+    replica, so a fleet merge can split prefill-side series (handoffs,
+    queue depth) from decode-side (tokens, admission) and router-side
+    (TTFT, sheds)."""
+    from dsml_tpu import obs
+
+    model, cfg = _tiny()
+    params = model.init(0)
+    obs.enable(forensics=False)
+    try:
+        reg = obs.get_registry()
+        tokens = reg.counter("serving_tokens_total", labels=("replica", "role"))
+        handoffs = reg.counter("serving_handoffs_total",
+                               labels=("replica", "role"))
+        ttft = reg.histogram("serving_ttft_ms", labels=("replica", "role"))
+        tpot = reg.histogram("serving_tpot_ms", labels=("replica", "role"))
+        tok0 = tokens.value(replica="0", role="decode")
+        hand0 = handoffs.value(replica="0", role="prefill")
+        ttft0 = ttft.summary(replica="router", role="router").get("count", 0)
+        tpot0 = tpot.summary(replica="0", role="decode").get("count", 0)
+        fleet = build_fleet(model, params, n_prefill=1, n_decode=1,
+                            prefill_chunk=8, n_slots=2)
+        for p, n in zip(_prompts(cfg, [6, 18], seed=10), (4, 4)):
+            fleet.submit(p, n)
+        fleet.run()
+        assert tokens.value(replica="0", role="decode") - tok0 == 8
+        assert handoffs.value(replica="0", role="prefill") - hand0 == 2
+        assert ttft.summary(replica="router", role="router")["count"] - ttft0 == 2
+        assert tpot.summary(replica="0", role="decode")["count"] - tpot0 == 2
+        depth = reg.gauge("serving_queue_depth", labels=("replica", "role"))
+        assert depth.value(replica="0", role="prefill") is not None
+        assert depth.value(replica="router", role="router") is not None
+    finally:
+        obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# decode-worker inject contract
+# ---------------------------------------------------------------------------
+
+
+def test_inject_validates_model_compat_and_sheds():
+    model, cfg = _tiny()
+    params = model.init(0)
+    h = _one_handoff(model, params, _prompts(cfg, [9], seed=11)[0])
+    dw = ContinuousBatcher(model, params, n_slots=1, max_queue=1)
+    with pytest.raises(ValueError, match="layers"):
+        dw.inject(h.prompt, 4, h.cache1[:1], h.logits)
+    dw.inject(h.prompt, 4, h.cache1, h.logits)
+    with pytest.raises(QueueFull):
+        dw.inject(h.prompt, 4, h.cache1, h.logits)
+    out = dw.run()
+    assert len(out) == 1
+
+
+def test_abandon_evacuates_injected_requests():
+    """A decode replica dying with handoffs still queued returns them from
+    abandon() like any unfinished request — the router re-prefills."""
+    model, cfg = _tiny()
+    params = model.init(0)
+    h = _one_handoff(model, params, _prompts(cfg, [9], seed=12)[0])
+    dw = ContinuousBatcher(model, params, n_slots=1)
+    rid = dw.inject(h.prompt, 4, h.cache1, h.logits)
+    assert dw.n_injected == 1
+    live = dw.abandon()
+    assert [r.rid for r in live] == [rid]
+    assert dw.n_injected == 0
