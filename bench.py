@@ -2740,6 +2740,235 @@ def _serving_fleet_main() -> None:
     print(json.dumps(out))
 
 
+def bench_paged_kv() -> dict:
+    """Paged int4 KV-cache section (docs/SERVING.md § Paged KV): the paged
+    batcher vs the dense-cache batcher at EQUAL HBM budget. Rows:
+    analytic bytes accounting (f32 dense rows vs int4 pages with per-row
+    scales → the capacity ratio), a measured concurrency leg (the paged
+    pool actually holding ≥4× the dense slot count in flight at the dense
+    cache's byte budget, greedy tokens BIT-IDENTICAL to the dense batcher
+    running the same int4 codec), the PR 10 burst schedule's p99
+    decode-gap A/B at equal slot count, and a page-size sweep (the
+    docs/TUNING.md defaults' provenance). Virtual-8 CPU subprocess like
+    the serving_fleet section: ratios and verdicts are the signal."""
+    code = "import bench; bench._paged_kv_main()"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, cwd=".",
+            timeout=max(min(600.0, _budget_left()), 120.0),
+        )
+        if proc.returncode != 0 or not proc.stdout.strip():
+            return {
+                "paged_kv_error": (
+                    f"rc={proc.returncode}; stderr tail: {proc.stderr[-300:]}"
+                )
+            }
+        res = json.loads(proc.stdout.strip().splitlines()[-1])
+        out = {f"paged_kv_{k}": v for k, v in res.items()}
+        out["paged_kv_note"] = (
+            "virtual-8 CPU: capacity ratios + bit-identity verdicts are "
+            "the signal; absolute walls are CPU (the HBM-bandwidth win of "
+            "int4 pages needs real chips). Equal analytic HBM budget per "
+            "variant; identical arrival schedules"
+        )
+        return out
+    except Exception as e:  # never fail the bench on the secondary section
+        return {"paged_kv_error": repr(e)[:200]}
+
+
+def _paged_kv_main() -> None:
+    """Subprocess entry for :func:`bench_paged_kv`.
+    ``DSML_PAGED_KV_TINY=1`` shrinks the workload for CI smoke."""
+    import numpy as np
+
+    from dsml_tpu.utils.platform import configure_platform
+
+    configure_platform("cpu", 8)
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.ops.quantization import kv_row_bytes
+    from dsml_tpu.serving import ContinuousBatcher
+
+    tiny = os.environ.get("DSML_PAGED_KV_TINY", "").lower() not in (
+        "", "0", "false", "off"
+    )
+    cfg = GPT2Config(vocab_size=256, max_seq=256, n_layer=2, n_head=4,
+                     d_model=64, d_ff=128)
+    model = GPT2(cfg)
+    import dataclasses as _dc
+
+    model_i4 = GPT2(_dc.replace(cfg, kv_quant="int4"))
+    params = model.init(0)
+    hd = cfg.d_model // cfg.n_head
+    chunk = 32
+    n_dense_slots = 4
+    page_size = 16
+
+    # ---- analytic bytes accounting (exact, not sampled) ----
+    def dense_slot_bytes(mode):
+        return cfg.n_layer * 2 * cfg.n_head * cfg.max_seq * kv_row_bytes(hd, mode)
+
+    def page_bytes(mode):
+        return cfg.n_layer * 2 * cfg.n_head * page_size * kv_row_bytes(hd, mode)
+
+    hbm_budget = n_dense_slots * dense_slot_bytes(None)  # the f32 dense cache
+    n_pages_at_budget = hbm_budget // page_bytes("int4")
+    out = {
+        "dense_slot_bytes_f32": dense_slot_bytes(None),
+        "page_bytes_int4": page_bytes("int4"),
+        "hbm_budget_bytes": hbm_budget,
+        "pages_at_budget": int(n_pages_at_budget),
+        "page_size": page_size, "dense_slots": n_dense_slots,
+        # worst case: every sequence reserves the full max_seq
+        "capacity_ratio_analytic": round(
+            (n_pages_at_budget * page_size) / (n_dense_slots * cfg.max_seq), 2
+        ),
+        "tiny": int(tiny),
+    }
+
+    # ---- measured concurrency at equal HBM: the paged pool (sized to the
+    # dense budget) holds >= 4x the dense slot count in flight ----
+    n_paged_slots = 4 * n_dense_slots
+    rng = np.random.default_rng(0)
+    n_req = 24 if tiny else 40
+    max_new = 12
+    prompts = [rng.integers(1, cfg.vocab_size, int(rng.integers(10, 40)))
+               .astype(np.int32) for _ in range(n_req)]
+
+    def peak_concurrency(batcher):
+        rids = [batcher.submit(p, max_new) for p in prompts]
+        peak = 0
+        for _ in range(100_000):
+            if (not batcher.n_queued and not batcher.n_injected
+                    and batcher.n_active == 0 and batcher.n_pending == 0):
+                break
+            batcher.step()
+            peak = max(peak, batcher.n_active)
+        return rids, batcher.collect(), peak
+
+    dense_i4 = ContinuousBatcher(model_i4, params, n_slots=n_dense_slots,
+                                 prefill_chunk=chunk)
+    d_rids, d_toks, d_peak = peak_concurrency(dense_i4)
+    paged = ContinuousBatcher(
+        model, params, n_slots=n_paged_slots, prefill_chunk=chunk,
+        paged_kv="int4", page_size=page_size,
+        n_pages=int(n_pages_at_budget),
+    )
+    p_rids, p_toks, p_peak = peak_concurrency(paged)
+    out["dense_peak_concurrent"] = d_peak
+    out["paged_peak_concurrent"] = p_peak
+    out["measured_concurrency_ratio"] = round(p_peak / max(d_peak, 1), 2)
+    out["greedy_bit_identical"] = int(all(
+        p_toks[a] == d_toks[b] for a, b in zip(p_rids, d_rids)
+    ))
+    _bump_progress()
+
+    # ---- PR 10 burst schedule at equal slot count: p99 decode gap A/B
+    # (paged gather + int4 codec vs the dense int4 cache) ----
+    n_bg, bg_dt = (10, 0.05) if tiny else (24, 0.05)
+    burst_sizes = (4,) if tiny else (6, 6)
+    bursty = [(0.05 + i * bg_dt,
+               rng.integers(1, cfg.vocab_size, int(rng.integers(8, 25)))
+               .astype(np.int32), 12) for i in range(n_bg)]
+    for j, size in enumerate(burst_sizes):
+        bursty += [(0.4 + 0.5 * j,
+                    rng.integers(1, cfg.vocab_size, int(rng.integers(128, 193)))
+                    .astype(np.int32), 8) for _ in range(size)]
+    bursty.sort(key=lambda a: a[0])
+
+    def drive_burst(batcher):
+        t0 = time.monotonic()
+        i, n = 0, len(bursty)
+        while i < n or batcher.n_active or batcher.n_queued or batcher.n_pending:
+            now = time.monotonic() - t0
+            while i < n and bursty[i][0] <= now:
+                batcher.submit(bursty[i][1], bursty[i][2])
+                i += 1
+            if i < n and not (batcher.n_active or batcher.n_queued
+                              or batcher.n_pending):
+                time.sleep(max(bursty[i][0] - (time.monotonic() - t0), 0.0))
+                continue
+            batcher.step()
+        batcher.collect()
+        return list(batcher._gaps)
+
+    for name, batcher in (
+        ("dense", ContinuousBatcher(model_i4, params, n_slots=n_dense_slots,
+                                    prefill_chunk=chunk)),
+        ("paged", ContinuousBatcher(model, params, n_slots=n_dense_slots,
+                                    prefill_chunk=chunk, paged_kv="int4",
+                                    page_size=page_size,
+                                    n_pages=int(n_pages_at_budget))),
+    ):
+        # warm the programs off the clock
+        batcher.submit(prompts[0], 3)
+        batcher.submit(rng.integers(1, cfg.vocab_size, 130).astype(np.int32), 3)
+        while batcher.n_active or batcher.n_queued or batcher.n_pending:
+            batcher.step()
+        batcher.collect()
+        batcher.reset_latency_stats()
+        gaps = drive_burst(batcher)
+        out[f"burst_{name}_gap_p50_ms"] = round(
+            float(np.percentile(gaps, 50)) * 1e3, 2)
+        out[f"burst_{name}_gap_p99_ms"] = round(
+            float(np.percentile(gaps, 99)) * 1e3, 2)
+    out["burst_gap_p99_ratio"] = round(
+        out["burst_paged_gap_p99_ms"]
+        / max(out["burst_dense_gap_p99_ms"], 1e-6), 3)
+    _bump_progress()
+
+    # ---- page-size sweep (docs/TUNING.md provenance): decode-tick wall
+    # + capacity at the same byte budget per page size ----
+    sweep_sizes = (8, 16) if tiny else (8, 16, 32)
+    sweep_prompts = prompts[: (8 if tiny else 16)]
+    for ps in sweep_sizes:
+        npg = int(hbm_budget // (cfg.n_layer * 2 * cfg.n_head * ps
+                                 * kv_row_bytes(hd, "int4")))
+        b = ContinuousBatcher(model, params, n_slots=n_dense_slots,
+                              prefill_chunk=chunk, paged_kv="int4",
+                              page_size=ps, n_pages=npg)
+        rids = [b.submit(p, max_new) for p in sweep_prompts]
+        while b.n_queued or b.n_active or b.n_pending:
+            b.step()  # warm + fill
+        b.collect()
+        walls = []
+        rids = [b.submit(p, max_new) for p in sweep_prompts]
+        while b.n_queued or b.n_active or b.n_pending:
+            t0 = time.monotonic()
+            b.step()
+            walls.append(time.monotonic() - t0)
+        b.collect()
+        out[f"sweep_page{ps}_tick_p50_ms"] = round(
+            float(np.percentile(walls, 50)) * 1e3, 3)
+        out[f"sweep_page{ps}_capacity_tokens"] = npg * ps
+    _bump_progress()
+
+    # ---- speculative acceptance: adaptive window on a repetitive
+    # workload (acceptance high -> wide windows) vs a random one ----
+    rep_prompts = [np.tile(rng.integers(1, 50, 6).astype(np.int32), 4)
+                   for _ in range(4)]
+    spec = ContinuousBatcher(
+        model, params, n_slots=2, prefill_chunk=chunk, speculative_window=6,
+        speculative_adaptive=True, paged_kv="int4", page_size=page_size,
+        n_pages=int(n_pages_at_budget),
+    )
+    for p in rep_prompts:
+        spec.submit(p, 16)
+    spec.run()
+    out["spec_accept_rate"] = (
+        round(spec.accept_ewma, 3) if spec.accept_ewma is not None else None
+    )
+    out["spec_windows_used"] = {str(k): v
+                                for k, v in sorted(spec.spec_window_used.items())}
+    # the speedup diagnostic: verify dispatches per emitted token — plain
+    # decode would pay 1.0 (an untrained model's near-repetitive greedy
+    # chain keeps acceptance high here; the adaptive NARROWING path is
+    # pinned white-box in tests, where acceptance can be forced low)
+    toks_emitted = 4 * 16
+    out["spec_ticks_per_token"] = round(spec.n_spec_ticks / toks_emitted, 3)
+    print(json.dumps(out))
+
+
 def bench_cluster() -> dict:
     """Cluster-observability section (``docs/OBSERVABILITY.md`` § Cluster):
 
@@ -3273,6 +3502,7 @@ _SECTIONS = {
     "forensics": bench_forensics,
     "chaos": bench_chaos,  # virtual-8 kill/restore schedules; no TPU rows
     "serving_fleet": bench_serving_fleet,  # disaggregated prefill/decode
+    "paged_kv": bench_paged_kv,  # paged int4 KV cache vs dense at equal HBM
     #                                        A/B vs monolithic; virtual-8
     "cluster": bench_cluster,  # aggregation-plane overhead + regress gate
     "migration": bench_migration,  # P2P shard-motion MB/s + recovery split
@@ -3613,6 +3843,14 @@ def main() -> None:
             extras.update(bench_serving_fleet())
         except Exception as e:
             errors["serving_fleet"] = repr(e)[:300]
+        _bump_progress()
+    # paged int4 KV cache vs dense at equal HBM (virtual-8 subprocess):
+    # capacity-ratio + bit-identity verdicts, budget-gated like the sweeps
+    if not _skip_for_budget(extras, "paged_kv", 300):
+        try:
+            extras.update(bench_paged_kv())
+        except Exception as e:
+            errors["paged_kv"] = repr(e)[:300]
         _bump_progress()
     _emit_final(extras, errors, no_tpu_signal, tpu_unreachable)
 

@@ -959,28 +959,18 @@ class GPT2:
         shape = (batch, n_heads, cfg.max_seq, hd)
         return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
-    def _kv_quantize(self, x):
+    def _kv_quantize(self, x, mode: str | None = None):
         """[b, h, s, hd] → (quantized values, f32 scale [b, h, s, 1]):
         symmetric absmax per position — each token's K/V row quantizes
         independently, so cache writes never touch other rows' scales.
-        int8 stores values directly; int4 packs two offset nibbles per
-        byte (q+8 in [1, 15], even channel in the high nibble)."""
-        x32 = x.astype(jnp.float32)
-        a = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
-        if self._kv_mode() == "int4":
-            s = jnp.where(a > 0, a / 7.0, 1.0)
-            # channel HALVES pack contiguously (high nibbles = channels
-            # [0, hd/2), low = [hd/2, hd)) so the unpack is a concat of two
-            # shift/mask ops — fusion-friendly, no interleaving gather that
-            # would materialize a full-width cache copy per step. The
-            # layout is ops.quantization.pack_int4 — THE shared nibble
-            # format the int4 collective wire path uses too (bit-identity
-            # to the original inline packing pinned in tests).
-            from dsml_tpu.ops.quantization import pack_int4
+        Delegates to ``ops.quantization.quantize_kv_rows`` — THE one KV
+        codec (int4 packs channel halves contiguously via the shared
+        ``pack_int4`` nibble format the collective wire path uses too),
+        so the dense cache and the serving page pool produce identical
+        bytes per row (the page-table gather parity rests on it)."""
+        from dsml_tpu.ops.quantization import quantize_kv_rows
 
-            return pack_int4(jnp.clip(jnp.round(x32 / s), -7, 7)), s
-        s = jnp.where(a > 0, a / 127.0, 1.0)
-        return jnp.round(x32 / s).astype(jnp.int8), s
+        return quantize_kv_rows(x, mode or self._kv_mode())
 
     def _cache_write(self, c: dict, kc, vc, write) -> dict:
         """Write new K/V rows through ``write(cache_array, new_rows)`` —
@@ -1333,6 +1323,235 @@ class GPT2:
             lambda arr, new: lax.dynamic_update_slice(arr, new, (0, 0, start, 0)),
             tp_axis,
             read_index=c - 1 if last_index is None else last_index,
+        )
+
+    # ---- paged KV cache (the serving page pool) --------------------------------
+    # The dense cache above pre-allocates max_seq rows PER SLOT; the paged
+    # variants below read/write a shared POOL of fixed-size token pages
+    # through a per-slot page table, so a worker's HBM pays for the rows
+    # requests actually hold (int4-quantized by default) instead of
+    # n_slots × max_seq dense rows — the concurrent-sequence capacity
+    # lever (``dsml_tpu.serving.batcher`` owns the allocator/CoW logic;
+    # docs/SERVING.md § Paged KV). Same layer loop, same attention, same
+    # sampling surfaces: only the cache placement (scatter at
+    # (physical page, row)) and the attention read (page-table gather)
+    # differ, which is what keeps paged tokens bit-identical to the
+    # dense quantized cache's (pinned in tests).
+
+    @staticmethod
+    def _page_mode(quant) -> str | None:
+        """None | "int8" | "int4" — normalized page-pool quantization
+        (the paged analog of :meth:`_kv_mode`, but per-call: a serving
+        pool's codec is a deployment choice, not a model-config one)."""
+        if not quant:
+            return None
+        if quant is True or quant == "int4":
+            return "int4"
+        if quant == "int8":
+            return "int8"
+        raise ValueError(
+            f"unknown page quant mode {quant!r}; choose False, 'int8', or "
+            "True/'int4'"
+        )
+
+    def init_page_pool(self, n_pages: int, page_size: int, tp_size: int = 1,
+                       quant="int4") -> list:
+        """Per-layer page pool: ``n_pages`` physical pages of ``page_size``
+        token rows each, shared by every slot through a page table.
+        ``page_size`` must divide ``max_seq`` (a slot's table then has
+        exactly ``max_seq // page_size`` entries and the gathered view is
+        shape-identical to the dense cache). Page 0 is the caller's
+        SCRATCH page by convention: free/retired slots point every table
+        entry at it, so their (masked, never-read) writes can't land in
+        another slot's pages."""
+        cfg = self.config
+        if cfg.n_head % tp_size:
+            raise ValueError(f"n_head={cfg.n_head} not divisible by tp={tp_size}")
+        if page_size < 1 or cfg.max_seq % page_size:
+            raise ValueError(
+                f"page_size must divide max_seq={cfg.max_seq}, got {page_size}"
+            )
+        if n_pages < 2:
+            raise ValueError(
+                f"need n_pages >= 2 (page 0 is the scratch page), got {n_pages}"
+            )
+        mode = self._page_mode(quant)
+        hd = cfg.d_model // cfg.n_head
+        n_heads = getattr(cfg, "n_kv_head", cfg.n_head) // tp_size
+        if mode == "int4":
+            if hd % 2:
+                raise ValueError(f"int4 pages need an even head_dim, got {hd}")
+            shape, dt = (n_pages, n_heads, page_size, hd // 2), jnp.uint8
+        elif mode == "int8":
+            shape, dt = (n_pages, n_heads, page_size, hd), jnp.int8
+        else:
+            shape, dt = (n_pages, n_heads, page_size, hd), jnp.dtype(cfg.dtype)
+        def entry():
+            # fresh buffers PER LAYER: sharing one zeros array across
+            # layers would hand the same buffer to the jitted programs
+            # twice, which donation rejects
+            e = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+            if mode:
+                sshape = (n_pages, n_heads, page_size, 1)
+                e.update(k_s=jnp.zeros(sshape, jnp.float32),
+                         v_s=jnp.zeros(sshape, jnp.float32))
+            return e
+
+        return [entry() for _ in range(cfg.n_layer)]
+
+    def _paged_write(self, c: dict, kc, vc, write, mode):
+        """The paged analog of :meth:`_cache_write`: quantize the new K/V
+        rows per the pool codec and place values + scales through the
+        caller's ``write`` (a scatter at (physical page, row in page))."""
+        if mode:
+            kq, ks = self._kv_quantize(kc, mode)
+            vq, vs = self._kv_quantize(vc, mode)
+            return {"k": write(c["k"], kq), "k_s": write(c["k_s"], ks),
+                    "v": write(c["v"], vq), "v_s": write(c["v_s"], vs)}
+        return {"k": write(c["k"], kc), "v": write(c["v"], vc)}
+
+    def _paged_attn_inputs(self, c: dict, page_table, mode):
+        """Gather one layer's pool through ``page_table`` [b, n_pt] into
+        the dense attention view ``[b, H, n_pt·page_size, ·]`` —
+        :meth:`_decode_attention` then runs unchanged (the gather IS the
+        paged-attention read; positions past a slot's depth land on
+        whatever page the table names, page 0 for unallocated entries,
+        and the validity mask never admits them)."""
+
+        def g(arr):
+            t = arr[page_table]  # [b, n_pt, H, page, x]
+            b, npt, h, pg, x = t.shape
+            return t.transpose(0, 2, 1, 3, 4).reshape(b, h, npt * pg, x)
+
+        if mode == "int4":
+            return (self._unpack_int4(g(c["k"])), self._unpack_int4(g(c["v"])),
+                    g(c["k_s"]), g(c["v_s"]))
+        if mode:
+            return g(c["k"]), g(c["v"]), g(c["k_s"]), g(c["v_s"])
+        return g(c["k"]), g(c["v"]), None, None
+
+    def _decode_core_paged(self, params, pool, page_table, h, positions,
+                           valid, write, tp_axis, mode, read_index=None):
+        """:meth:`_decode_core` against a page pool: per layer — norm →
+        qkv → quantized page write (the caller's scatter placement) →
+        page-table-gathered cached attention → wo/psum → ffn. The three
+        paged serving surfaces (decode / chunked prefill / verify) differ
+        only in positions/valid/write, exactly like their dense twins."""
+        tp_size = lax.axis_size(tp_axis) if tp_axis else 1
+        new_pool = []
+        for layer, c in zip(params["layers"], pool):
+            x = self._norm1(layer, h)
+            q, kc, vc, _, _ = self._serving_qkv(layer, x, positions, tp_size)
+            c = self._paged_write(c, kc, vc, write, mode)
+            ck, cv, k_s, v_s = self._paged_attn_inputs(c, page_table, mode)
+            out = self._decode_attention(q, ck, cv, valid, k_s, v_s)
+            attn_out = self._merge_heads(out) @ maybe_dequant(layer["attn"]["wo"], h.dtype)
+            if tp_axis:
+                attn_out = lax.psum(attn_out, tp_axis)
+            h = h + attn_out + self._attn_out_bias(layer)
+            h = self._ffn(layer, h, tp_axis)
+            new_pool.append(c)
+        h = self._final_norm(params, h)
+        if isinstance(read_index, str) and read_index == "all":
+            h_last = h
+        elif read_index is None:
+            h_last = h[:, 0]
+        else:
+            h_last = lax.dynamic_index_in_dim(
+                h, jnp.asarray(read_index, jnp.int32), axis=1, keepdims=False
+            )
+        return self._unembed_full(params, h_last, tp_axis), new_pool
+
+    def decode_step_slots_paged(
+        self, params: dict, pool: list, page_table: jax.Array,
+        tokens: jax.Array, pos: jax.Array, tp_axis: str | None = None,
+        quant="int4",
+    ):
+        """:meth:`decode_step_slots` against a page pool: ``page_table``
+        [b, max_seq/page_size] names each slot's physical pages; the new
+        K/V row scatters at (table[b, pos[b]//page], pos[b] % page).
+        Returns (logits [b, vocab], updated pool)."""
+        cfg = self.config
+        b = tokens.shape[0]
+        mode = self._page_mode(quant)
+        pos = jnp.asarray(pos, jnp.int32)
+        page_size = cfg.max_seq // page_table.shape[1]
+        positions = pos[:, None]
+        h = self._embed_spmd(params, tokens[:, None], tp_axis, seq_offset=positions)
+        valid = jnp.arange(cfg.max_seq)[None, :] <= pos[:, None]
+        bidx = jnp.arange(b)
+        phys = page_table[bidx, pos // page_size]  # [b]
+        row = pos % page_size
+
+        def write(arr, new):  # arr [P, H, page, x], new [b, H, 1, x]
+            return arr.at[phys, :, row, :].set(new[:, :, 0, :])
+
+        return self._decode_core_paged(
+            params, pool, page_table, h, positions, valid, write, tp_axis, mode
+        )
+
+    def prefill_chunk_paged(
+        self, params: dict, pool: list, page_table: jax.Array,
+        tokens: jax.Array, start, tp_axis: str | None = None,
+        last_index=None, quant="int4",
+    ):
+        """:meth:`prefill_chunk` against a page pool: ``tokens`` [1, C] at
+        global positions ``start..start+C-1`` scatter into the pages the
+        1-row ``page_table`` [1, n_pt] names. Chunk chaining under a
+        quantized pool is CHUNK-SIZE-INVARIANT (every query reads every
+        key quantized, regardless of where chunk boundaries fall), which
+        is why prefix pages registered with one chunk size match a
+        prefill worker's bytes at another — pinned in tests."""
+        cfg = self.config
+        _, c = tokens.shape
+        mode = self._page_mode(quant)
+        start = jnp.asarray(start, jnp.int32)
+        page_size = cfg.max_seq // page_table.shape[1]
+        positions = start + jnp.arange(c, dtype=jnp.int32)  # [C] global
+        h = self._embed_spmd(params, tokens, tp_axis, seq_offset=start)
+        valid = (
+            jnp.arange(cfg.max_seq)[None, None, :] <= positions[None, :, None]
+        )  # [1, C, S]
+        phys = page_table[0, positions // page_size]  # [C]
+        row = positions % page_size
+
+        def write(arr, new):  # arr [P, H, page, x], new [1, H, C, x]
+            return arr.at[phys, :, row, :].set(new[0].transpose(1, 0, 2))
+
+        return self._decode_core_paged(
+            params, pool, page_table, h, positions, valid, write, tp_axis,
+            mode, read_index=c - 1 if last_index is None else last_index,
+        )
+
+    def verify_step_paged(
+        self, params: dict, pool: list, page_table: jax.Array,
+        tokens: jax.Array, start, tp_axis: str | None = None, quant="int4",
+    ):
+        """:meth:`verify_step` against a page pool — the speculative
+        verify window [b, C] written/read through each slot's page table.
+        Rejected drafts leave garbage rows in the slot's own reserved
+        pages (never shared ones — the allocator reserves decode+window
+        rows privately), and the next window overwrites them before any
+        query attends — the dense path's invariant, unchanged."""
+        cfg = self.config
+        b, c = tokens.shape
+        mode = self._page_mode(quant)
+        start = jnp.asarray(start, jnp.int32)  # [b]
+        page_size = cfg.max_seq // page_table.shape[1]
+        positions = start[:, None] + jnp.arange(c, dtype=jnp.int32)  # [b, C]
+        h = self._embed_spmd(params, tokens, tp_axis, seq_offset=start[:, None])
+        valid = (
+            jnp.arange(cfg.max_seq)[None, None, :] <= positions[:, :, None]
+        )  # [b, C, S]
+        phys = page_table[jnp.arange(b)[:, None], positions // page_size]  # [b, C]
+        row = positions % page_size
+
+        def write(arr, new):  # arr [P, H, page, x], new [b, H, C, x]
+            return arr.at[phys, :, row, :].set(new.transpose(0, 2, 1, 3))
+
+        return self._decode_core_paged(
+            params, pool, page_table, h, positions, valid, write, tp_axis,
+            mode, read_index="all",
         )
 
     def generate(

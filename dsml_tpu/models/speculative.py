@@ -44,9 +44,71 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
-__all__ = ["generate_speculative"]
+__all__ = ["generate_speculative", "lookup_draft_host", "lookup_draft_batch"]
+
+
+def lookup_draft_host(history: np.ndarray, n: int, k: int) -> np.ndarray:
+    """Prompt-lookup draft, HOST side (numpy): the ``k`` tokens that
+    followed the MOST RECENT prior occurrence of ``history``'s trailing
+    n-gram; repeats the last token when no match exists (acceptance then
+    falls to the guaranteed +1-token/tick floor — wrong drafts only cost
+    speed, never tokens). THE one host drafting rule: the continuous
+    batcher's speculative tick drafts through here, and
+    :func:`lookup_draft_batch` is the same rule device-side (the
+    in-``lax.while_loop`` speculator) — equivalence pinned in tests."""
+    history = np.asarray(history)
+    length = len(history)
+    n = min(n, length)
+    gram = history[length - n:]
+    win = np.lib.stride_tricks.sliding_window_view(history, n)  # [L-n+1, n]
+    # exclude only the trailing gram itself (windows ending before the last
+    # position; overlap with the gram region is allowed) — the same rule as
+    # the device-side lookup (j + n - 1 < pos)
+    matches = np.flatnonzero(np.all(win[: length - n] == gram, axis=1))
+    if len(matches) == 0:
+        return np.full(k, history[-1], np.int32)
+    best = int(matches[-1])
+    src = history[best + n : best + n + k].astype(np.int32)
+    if len(src) < k:  # match near the end: pad with last-token repeats
+        src = np.concatenate([src, np.full(k - len(src), history[-1], np.int32)])
+    return src
+
+
+def lookup_draft_batch(hbuf: jax.Array, pos: jax.Array, window: int,
+                      ngram: int) -> jax.Array:
+    """Prompt-lookup draft, DEVICE side (traceable): for each row of
+    ``hbuf`` [b, max_seq] whose last accepted token sits at ``pos[b]``,
+    the ``window - 1`` tokens that followed the most recent match of the
+    trailing ``ngram``-gram strictly inside accepted history
+    (``j + ngram - 1 < pos``); no match → repeat the last token. Static
+    ``ngram`` unrolls into shifted equalities — no gather, no sort.
+    Shared by the jitted speculative ``while_loop`` and (via vmap in
+    tests) pinned equivalent to :func:`lookup_draft_host`."""
+    b, max_seq = hbuf.shape
+    n, c = ngram, window
+    jidx = jnp.arange(max_seq - n + 1, dtype=jnp.int32)
+    # gram[b] = hbuf[b, pos-n+1 .. pos]
+    gram = jax.vmap(
+        lambda h, p: lax.dynamic_slice_in_dim(h, p - (n - 1), n)
+    )(hbuf, pos)  # [b, n]
+    match = jnp.ones((b, max_seq - n + 1), bool)
+    for i in range(n):  # static n (2-3): unrolled shifted equality
+        match &= hbuf[:, i : max_seq - n + 1 + i] == gram[:, i : i + 1]
+    # window must end strictly inside accepted history (j+n-1 < pos)
+    legal = jidx[None, :] <= pos[:, None] - n
+    best = jnp.max(jnp.where(match & legal, jidx[None, :], -1), axis=1)  # [b]
+    found = best >= 0
+    src = best[:, None] + n + jnp.arange(c - 1, dtype=jnp.int32)[None, :]
+    # a match near the end runs out of followers: read the LAST ACCEPTED
+    # token instead of whatever sits past pos in the buffer (unfilled or
+    # stale rows) — the host rule's pad-with-last, and a strictly better
+    # draft than garbage (wrong drafts only cost speed, never tokens)
+    src = jnp.where(src <= pos[:, None], src, pos[:, None])
+    draft = jnp.take_along_axis(hbuf, src, axis=1)
+    return jnp.where(found[:, None], draft, gram[:, -1:])  # [b, C-1]
 
 
 def _build_speculative_fn(model, prompt_len: int, max_new: int, window: int, ngram: int):
@@ -72,30 +134,9 @@ def _build_speculative_fn(model, prompt_len: int, max_new: int, window: int, ngr
         pos = jnp.full((b,), t, jnp.int32)  # position of last accepted token
         n_gen = jnp.ones((b,), jnp.int32)
 
-        jidx = jnp.arange(max_seq - n + 1, dtype=jnp.int32)
-
-        def lookup_draft(hbuf, pos):
-            """Most recent n-gram match → the C-1 tokens that followed it.
-            No match → repeat the last token (acceptance simply drops to
-            the guaranteed +1/iteration floor)."""
-            # gram[b] = hbuf[b, pos-n+1 .. pos]
-            gram = jax.vmap(
-                lambda h, p: lax.dynamic_slice_in_dim(h, p - (n - 1), n)
-            )(hbuf, pos)  # [b, n]
-            match = jnp.ones((b, max_seq - n + 1), bool)
-            for i in range(n):  # static n (2-3): unrolled shifted equality
-                match &= hbuf[:, i : max_seq - n + 1 + i] == gram[:, i : i + 1]
-            # window must end strictly inside accepted history (j+n-1 < pos)
-            legal = jidx[None, :] <= pos[:, None] - n
-            best = jnp.max(jnp.where(match & legal, jidx[None, :], -1), axis=1)  # [b]
-            found = best >= 0
-            src = best[:, None] + n + jnp.arange(c - 1, dtype=jnp.int32)[None, :]
-            draft = jnp.take_along_axis(hbuf, jnp.clip(src, 0, max_seq - 1), axis=1)
-            return jnp.where(found[:, None], draft, gram[:, -1:])  # [b, C-1]
-
         def body(state):
             hbuf, cache, pos, n_gen, calls = state
-            draft = lookup_draft(hbuf, pos)
+            draft = lookup_draft_batch(hbuf, pos, c, n)
             last = jnp.take_along_axis(hbuf, pos[:, None], axis=1)  # [b, 1]
             window_toks = jnp.concatenate([last, draft], axis=1)  # [b, C]
             logits, cache = model.verify_step(params, cache, window_toks, pos)

@@ -176,10 +176,18 @@ _NOT_A_METRIC = (
     # serving_fleet section: worker/slot/chunk counts are configuration,
     # not measurements
     "_workers", "_slots", "_chunk",
+    # paged_kv section: pool sizing and page geometry are configuration,
+    # bit-identity is a verdict the contract test asserts (never a noise
+    # band), peak-concurrent counts ride the gated concurrency RATIO, and
+    # acceptance/window telemetry is workload-dependent
+    "pages_at_budget", "page_size", "bit_identical", "_peak_concurrent",
+    "capacity_tokens", "windows_used", "accept_rate", "ticks_per_token",
 )
 _HIGHER_BETTER = (
     "samples_per_sec", "tokens_per_sec", "tokens_per_s", "goodput",
     "accuracy", "mfu", "speedup", "coverage_pct",
+    # paged_kv: concurrent-sequence capacity per HBM byte — the headline
+    "capacity_ratio", "concurrency_ratio",
 )
 _LOWER_BETTER_SUFFIX = ("_ms", "_s", "_sec", "_pct", "_ppl")
 # "ttft"/"tpot": the serving_fleet section's time-to-first-token and

@@ -59,6 +59,9 @@ __all__ = [
     "quant_algorithm_for",
     "pack_int4",
     "unpack_int4",
+    "quantize_kv_rows",
+    "dequantize_kv_rows",
+    "kv_row_bytes",
     "quantize_int8",
     "dequantize_int8",
     "quantize_roundtrip",
@@ -297,6 +300,62 @@ def unpack_int4(p: jax.Array) -> jax.Array:
     hi = (p >> 4).astype(jnp.int8) - 8
     lo = (p & 0xF).astype(jnp.int8) - 8
     return jnp.concatenate([hi, lo], axis=-1)
+
+
+def quantize_kv_rows(x: jax.Array, mode: str = "int4"):
+    """Symmetric absmax quantization of KV rows ``[..., rows, hd]`` →
+    ``(values, f32 scales [..., rows, 1])`` — one scale PER ROW (a row is
+    one token position's K or V vector), so a row quantizes independently
+    of every other row in its page: cache/page writes never touch other
+    positions' scales, and the bytes are identical whether the rows live
+    in a dense ``[b, h, max_seq, hd]`` cache or a paged ``[pages, h,
+    page_size, hd]`` pool (the page-table gather parity the paged KV
+    cache rests on). ``mode="int8"`` stores int8 values directly;
+    ``"int4"`` packs two offset nibbles per byte (:func:`pack_int4` —
+    even ``hd`` required). THE one KV codec: the GPT-2/Llama dense
+    quantized cache and the serving page pool both quantize through
+    here (bit-identity pinned in tests)."""
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"unknown KV quant mode {mode!r}; choose 'int8' or 'int4'")
+    x32 = x.astype(jnp.float32)
+    a = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    if mode == "int4":
+        if x.shape[-1] % 2:
+            raise ValueError(
+                f"int4 KV rows need an even trailing dim, got {x.shape}"
+            )
+        s = jnp.where(a > 0, a / 7.0, 1.0)
+        return pack_int4(jnp.clip(jnp.round(x32 / s), -7, 7)), s
+    s = jnp.where(a > 0, a / 127.0, 1.0)
+    return jnp.round(x32 / s).astype(jnp.int8), s
+
+
+def dequantize_kv_rows(values: jax.Array, scales: jax.Array,
+                       mode: str = "int4") -> jax.Array:
+    """Inverse of :func:`quantize_kv_rows` → f32 rows ``[..., rows, hd]``.
+    The serving hot path never calls this (attention feeds the int8
+    values into its dots and folds the scales after — see
+    ``GPT2._cache_attn_inputs``); it exists for codec round-trip tests
+    and host-side tooling that wants the dequantized rows."""
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"unknown KV quant mode {mode!r}; choose 'int8' or 'int4'")
+    q = unpack_int4(values) if mode == "int4" else values
+    return q.astype(jnp.float32) * scales
+
+
+def kv_row_bytes(head_dim: int, mode: str | None) -> int:
+    """HBM bytes one K or V row (one position, one head) costs under
+    ``mode`` (None = f32), scale included — the analytic accounting the
+    paged-KV capacity bench and docs/TUNING.md sizing rules use."""
+    if mode is None:
+        return 4 * head_dim
+    if mode == "int8":
+        return head_dim + 4  # int8 values + one f32 scale
+    if mode == "int4":
+        if head_dim % 2:
+            raise ValueError(f"int4 KV rows need an even head_dim, got {head_dim}")
+        return head_dim // 2 + 4  # two nibbles per byte + one f32 scale
+    raise ValueError(f"unknown KV quant mode {mode!r}")
 
 
 def _block_quant(blocks: jax.Array, scheme: QuantScheme, seed=None):

@@ -515,6 +515,7 @@ def run_smoke(n_steps: int = 24, seeds: tuple = (), checkpoint_every: int = 4,
         if serving:
             report["serving"] = _serving_smoke(model, cfg, rng)
             report["serving_fleet"] = _serving_fleet_smoke(model, cfg, rng)
+            report["serving_paged"] = _paged_serving_smoke(model, cfg, rng)
     finally:
         if created:
             shutil.rmtree(base, ignore_errors=True)
@@ -593,6 +594,79 @@ def _serving_fleet_smoke(model, cfg, rng) -> dict:
         "ticks": out["ticks"],
         "requeued_prefill": out["requeued_prefill"],
         "requeued_decode": out["requeued_decode"],
+    }
+
+
+def _paged_serving_smoke(model, cfg, rng) -> dict:
+    """Paged-KV fleet loss smoke (docs/SERVING.md § Paged KV): a paged
+    2-prefill/2-decode fleet with a LIVE CoW prefix (registered fleet-wide,
+    shared read-only across matching requests) loses a decode worker
+    mid-flight. Invariants: re-prefilled requests on survivors produce
+    tokens identical to a monolithic paged batcher (greedy + deterministic
+    int4 codec ⇒ pure function of the prompt), the CoW sharing was
+    actually live when the kill landed, and EVERY worker's pool — the
+    killed one's included — reclaims its request pages without leaking
+    capacity (only the prefix registry's pages stay held)."""
+    from dsml_tpu.serving import ContinuousBatcher, build_fleet
+
+    params = model.init(0)
+    page_size = 8
+    prefix = rng.integers(1, cfg.vocab_size, 20).astype(np.int32)
+    prompts = []
+    for i in range(6):
+        tail = rng.integers(1, cfg.vocab_size,
+                            int(rng.integers(4, 12))).astype(np.int32)
+        prompts.append(np.concatenate([prefix, tail]) if i % 2 else
+                       rng.integers(1, cfg.vocab_size,
+                                    int(rng.integers(8, 24))).astype(np.int32))
+    max_new = 6
+    ref = ContinuousBatcher(model, params, n_slots=2, prefill_chunk=8,
+                            paged_kv="int4", page_size=page_size, n_pages=80)
+    ref.register_prefix(prefix)
+    ref_rids = [ref.submit(p, max_new) for p in prompts]
+    ref_tokens = ref.run()
+
+    router = build_fleet(
+        model, params, n_prefill=2, n_decode=2, prefill_chunk=8,
+        paged_kv="int4", page_size=page_size, n_slots=2, max_queue=8,
+        n_pages=80,
+    )
+    router.register_prefix(prefix)
+    workers = list(router.decode_workers) + list(router.prefill_workers)
+    baseline_used = [w.used_pages if hasattr(w, "used_pages")
+                     else w._pages.used_pages for w in workers]
+    frids = [router.submit(p, max_new) for p in prompts]
+    tick = 0
+    peak_shared = 0
+    while router.outstanding:
+        if tick == 6:
+            router.kill_decode_worker()
+        router.tick()
+        peak_shared = max(peak_shared, max(
+            dw.shared_pages for dw in router.decode_workers
+        ))
+        tick += 1
+        if tick > 100_000:
+            raise RuntimeError("paged serving chaos did not drain")
+    results = router.run(max_ticks=1)
+    token_loss = sum(
+        1 for frid, rrid in zip(sorted(frids), ref_rids)
+        if results.get(frid, []) != ref_tokens[rrid]
+    )
+    # no-leak audit over EVERY pool, the killed worker's included: after
+    # the drain each pool holds exactly its prefix-registry pages again
+    leaked = 0
+    for w, base in zip(workers, baseline_used):
+        used = (w.used_pages if hasattr(w, "used_pages")
+                else w._pages.used_pages)
+        leaked += max(used - base, 0)
+    return {
+        "requests": len(prompts),
+        "token_mismatches": token_loss,
+        "ticks": tick,
+        "requeued_decode": router.requeued_decode,
+        "peak_shared_pages": peak_shared,
+        "leaked_pages": leaked,
     }
 
 
@@ -1003,6 +1077,29 @@ def verify(report: dict) -> list[str]:
             bad.append(
                 "serving_fleet: the decode-worker kill interrupted no "
                 "work — the full-pipeline re-run path went unexercised"
+            )
+    paged = report.get("serving_paged")
+    if paged is not None:
+        if paged.get("token_mismatches", 0) > 0:
+            bad.append(
+                f"serving_paged: {paged['token_mismatches']} request(s) "
+                "lost or changed tokens across the decode-worker kill"
+            )
+        if not paged.get("requeued_decode"):
+            bad.append(
+                "serving_paged: the decode-worker kill interrupted no work "
+                "— the paged re-prefill path went unexercised"
+            )
+        if not paged.get("peak_shared_pages"):
+            bad.append(
+                "serving_paged: no CoW prefix page was ever shared — the "
+                "kill did not land with sharing live"
+            )
+        if paged.get("leaked_pages", 0) > 0:
+            bad.append(
+                f"serving_paged: {paged['leaked_pages']} pool page(s) "
+                "leaked past request retirement (the dead worker's pages "
+                "must reclaim without shrinking pool capacity)"
             )
     return bad
 

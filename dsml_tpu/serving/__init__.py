@@ -16,7 +16,11 @@ into the fleet shape production traffic wants (docs/SERVING.md):
   cross-host stream path.
 - :mod:`router`   — ``Router``: SLO-class admission with explicit
   shedding, load-aware dispatch over N prefill + M decode workers using
-  queue depth and measured TTFT/TPOT, prefix replication, chaos hooks.
+  queue depth and an acceptance-aware TPOT cost model, prefix
+  replication, chaos hooks.
+- :mod:`paging`   — the paged-KV host side: refcounting page-pool
+  allocator + copy-on-write admission planning shared by the batcher
+  (decode role) and the prefill worker (docs/SERVING.md § Paged KV).
 
 The interference problem this removes: one batcher interleaves prefill
 chunks with decode quanta, so a burst of long prompts inflates every

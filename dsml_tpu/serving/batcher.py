@@ -33,6 +33,14 @@ rows scatter into a slot exactly like a local admission's, and the first
 token samples from the handed-off logits with the identical
 (seed, key_rid, step) PRNG fold, so disaggregation never changes tokens
 (pinned in tests).
+
+``paged_kv`` replaces the dense per-slot cache with a PAGED one: a pool
+of fixed-size token pages (int4 block-quantized by default), a per-slot
+page table the attention gathers through, and a host-side refcounting
+allocator (``serving.paging``) — so a chip's HBM pays for the rows
+requests actually hold instead of ``n_slots × max_seq`` dense rows, and
+registered prefixes become COPY-ON-WRITE page-table entries shared
+read-only across every matching request (docs/SERVING.md § Paged KV).
 """
 
 from __future__ import annotations
@@ -85,26 +93,10 @@ def _bucket(n: int, buckets: tuple) -> int:
     raise ValueError(f"prompt length {n} exceeds the largest bucket {buckets[-1]}")
 
 
-def _lookup_draft(history: np.ndarray, n: int, k: int) -> np.ndarray:
-    """Prompt-lookup draft: the k tokens that followed the MOST RECENT
-    prior occurrence of ``history``'s trailing n-gram; repeats the last
-    token when no match exists (acceptance then falls to the guaranteed
-    +1-token/tick floor — wrong drafts only cost speed, never tokens)."""
-    length = len(history)
-    n = min(n, length)
-    gram = history[length - n:]
-    win = np.lib.stride_tricks.sliding_window_view(history, n)  # [L-n+1, n]
-    # exclude only the trailing gram itself (windows ending before the last
-    # position; overlap with the gram region is allowed) — the same rule as
-    # the device-side lookup in models/speculative.py (j + n - 1 < pos)
-    matches = np.flatnonzero(np.all(win[: length - n] == gram, axis=1))
-    if len(matches) == 0:
-        return np.full(k, history[-1], np.int32)
-    best = int(matches[-1])
-    src = history[best + n : best + n + k].astype(np.int32)
-    if len(src) < k:  # match near the end: pad with last-token repeats
-        src = np.concatenate([src, np.full(k - len(src), history[-1], np.int32)])
-    return src
+# the host-side prompt-lookup draft rule lives with its device twin in
+# models/speculative.py — ONE drafting rule for the standalone speculator
+# and the batcher's speculative tick (equivalence pinned in tests)
+from dsml_tpu.models.speculative import lookup_draft_host as _lookup_draft
 
 
 class ContinuousBatcher:
@@ -198,6 +190,30 @@ class ContinuousBatcher:
     rejected drafts leave garbage cache rows that the next verify window
     always overwrites before any query attends to them
     (``verify_step``'s invariant).
+
+    ``speculative_adaptive`` — the verify-window width adapts per tick to
+    the measured draft-acceptance EWMA (2..``speculative_window``), so a
+    workload whose drafts stop landing stops paying wide-window verify
+    FLOPs; greedy tokens are identical at any width (pinned in tests).
+    The same EWMAs drive :meth:`predicted_tpot_s`, the router's
+    acceptance-aware TPOT cost model.
+
+    ``paged_kv`` — replace the dense per-slot cache with a page POOL:
+    ``n_pages`` physical pages of ``page_size`` token rows (int4
+    block-quantized with per-row scales by default; ``"int8"``/``False``
+    for the wider codecs), a per-slot page table the attention gathers
+    through, and a host-side refcounting allocator. Admission reserves
+    every page a request can ever touch up front (no mid-flight
+    preemption), ``register_prefix`` becomes a COPY-ON-WRITE page-table
+    entry (matching requests share the prefix's full pages read-only; a
+    straddling tail page is materialized privately only because the slot
+    writes into it), and ``inject`` lands shipped PAGES plus local
+    shared-prefix references. Requires chunked admission
+    (``prefill_chunk > 0``) for local submits; single-device; greedy
+    tokens are bit-identical to a dense batcher running the same KV
+    codec (``kv_quant``), and the pool holds ~8× more rows per HBM byte
+    than the dense f32 cache (docs/SERVING.md § Paged KV,
+    docs/TUNING.md for sizing).
     """
 
     def __init__(
@@ -216,9 +232,13 @@ class ContinuousBatcher:
         prefill_chunk: int = 0,
         speculative_window: int = 0,
         speculative_ngram: int = 2,
+        speculative_adaptive: bool = False,
         adaptive_quantum: int = 0,
         max_queue: int = 0,
         mesh=None,
+        paged_kv=False,
+        page_size: int = 16,
+        n_pages: int = 0,
     ):
         """``mesh`` — a framework mesh (``parallel.mesh.build_mesh``) makes
         serving TENSOR-PARALLEL: params are Megatron-sharded
@@ -249,8 +269,54 @@ class ContinuousBatcher:
         # the in-flight chunked admission: (request, reserved slot,
         # accumulating 1-row cache, next chunk's start position) — at most
         # one at a time; its reserved slot holds rid -2 so neither the
-        # decode mask (>= 0) nor the free-slot scan (== -1) touches it
+        # decode mask (>= 0) nor the free-slot scan (== -1) touches it.
+        # (Paged mode drops the cache1 element: chunks write straight into
+        # the slot's reserved pool pages — (request, slot, next start).)
         self._pending = None
+
+        # ---- paged KV cache (docs/SERVING.md § Paged KV) ----
+        # "fp" = unquantized pages (full-precision gather parity — the
+        # page-table machinery alone, no codec): mode None, paged True
+        self.page_quant = (None if paged_kv == "fp"
+                           else model._page_mode(paged_kv))  # None|int8|int4
+        self.paged = bool(paged_kv)
+        self.page_size = int(page_size)
+        if self.paged:
+            if mesh is not None:
+                raise ValueError(
+                    "paged_kv is single-device (the dense cache carries the "
+                    "TP serving path); drop mesh= or paged_kv="
+                )
+            if turbo_factor or adaptive_quantum:
+                raise ValueError(
+                    "paged_kv composes with plain decode quanta and "
+                    "speculative windows; turbo_factor/adaptive_quantum are "
+                    "dense-cache escalations"
+                )
+            if cfg.max_seq % self.page_size:
+                raise ValueError(
+                    f"page_size must divide max_seq={cfg.max_seq}, got "
+                    f"{self.page_size}"
+                )
+            self._n_pt = cfg.max_seq // self.page_size  # table entries/slot
+            # 0 = parity sizing: every slot can hold max_seq rows, like the
+            # dense cache — the capacity win comes from sizing it DOWN to
+            # the workload (docs/TUNING.md has the accounting)
+            self.n_pages = int(n_pages) or n_slots * self._n_pt + 1
+            from dsml_tpu.serving.paging import PagePool
+
+            self._pages = PagePool(self.n_pages)
+            # host page table: row per slot, entry 0 (the scratch page) for
+            # everything unallocated; device copy rides along per dispatch
+            self._page_table = np.zeros((n_slots, self._n_pt), np.int32)
+            self._slot_pages: list[list] = [[] for _ in range(n_slots)]
+            self.n_cow_copies = 0
+            # pages the prefix registry holds FOREVER — the never-fits
+            # checks subtract these from the reservable ceiling (a pool
+            # mostly eaten by registrations must reject, not livelock)
+            self._registry_pages = 0
+        else:
+            self.n_pages = 0
 
         if max_queue < 0:
             raise ValueError(f"max_queue must be >= 0 (0 = unbounded), got {max_queue}")
@@ -350,6 +416,23 @@ class ContinuousBatcher:
                 )
         self.speculative_window = int(speculative_window)
         self.speculative_ngram = int(speculative_ngram)
+        if speculative_adaptive and not speculative_window:
+            raise ValueError(
+                "speculative_adaptive adapts the speculative window; set "
+                "speculative_window >= 2"
+            )
+        self.speculative_adaptive = bool(speculative_adaptive)
+        # speculative acceptance telemetry: per-slot EWMAs of the draft
+        # acceptance rate plus a batcher-level EWMA, the measured verify
+        # tick wall, and the committed-tokens-per-slot-tick EWMA — the
+        # inputs to the adaptive window choice here and to the router's
+        # acceptance-aware TPOT cost model (predicted_tpot_s)
+        self._slot_accept = np.full(n_slots, np.nan)
+        self.accept_ewma: float | None = None
+        self.spec_tick_s_ewma: float | None = None
+        self.commit_ewma: float | None = None
+        self.n_spec_ticks = 0
+        self.spec_window_used: dict[int, int] = {}  # width -> tick count
         max_seq = cfg.max_seq
         temperature = self.temperature
         top_k, top_p = self.top_k, self.top_p
@@ -391,6 +474,35 @@ class ContinuousBatcher:
         decode_turbo = (
             make_decode_k(decode_quantum * turbo_factor) if turbo_factor else None
         )
+
+        def make_decode_k_paged(k):
+            """``make_decode_k`` against the page pool: same k-chained
+            scan + sampling (identical (seed, rid, step) folds — paged vs
+            dense never changes WHICH token is sampled, only where its
+            K/V row lives), cache writes/reads routed through the page
+            table."""
+            pq = self.page_quant
+
+            def decode_k_paged(p, pool, table, t, pos, base_keys, steps_done):
+                def body(carry, i):
+                    pool, t, pos = carry
+                    logits, pool = model.decode_step_slots_paged(
+                        p, pool, table, t, pos, None, pq
+                    )
+                    if temperature <= 0.0:
+                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    else:
+                        def one(row, key, n_done):
+                            k2 = jax.random.fold_in(key, n_done + i)
+                            return sample_token_logits(row, k2, temperature, top_k, top_p)
+
+                        nxt = jax.vmap(one)(logits, base_keys, steps_done)
+                    return (pool, nxt, jnp.minimum(pos + 1, max_seq - 1)), nxt
+
+                (pool, _, _), toks = lax.scan(body, (pool, t, pos), jnp.arange(k))
+                return toks, pool  # toks [k, B]
+
+            return decode_k_paged
 
         def make_decode_until(k_max):
             """Early-exit decode loop: up to ``k_max`` chained slot-decode
@@ -463,7 +575,53 @@ class ContinuousBatcher:
         def verify_fn(p, c, toks, pos):  # toks [B, W], pos [B] per-slot depth
             return model.verify_step(p, c, toks, pos, tp_axis)
 
-        if mesh is None:
+        if self.paged:
+            pq = self.page_quant
+            self.params = params
+            self._pool = model.init_page_pool(
+                self.n_pages, self.page_size, quant=pq
+            )
+            # the pool is donated every dispatch, exactly like the dense
+            # cache: XLA updates the page buffers in place
+            self._decode_paged = jax.jit(
+                make_decode_k_paged(decode_quantum), donate_argnums=(1,)
+            )
+
+            def chunk_paged_fn(p, pool, table, toks, start, last):
+                return model.prefill_chunk_paged(
+                    p, pool, table, toks, start, None, last_index=last,
+                    quant=pq,
+                )
+
+            self._prefill_chunk_paged = jax.jit(
+                chunk_paged_fn, donate_argnums=(1,)
+            )
+
+            def verify_paged_fn(p, pool, table, toks, pos):
+                return model.verify_step_paged(
+                    p, pool, table, toks, pos, None, quant=pq
+                )
+
+            # jit retraces per window width, so ONE program object serves
+            # the adaptive ladder (each width compiles once)
+            self._verify_paged = jax.jit(verify_paged_fn, donate_argnums=(1,))
+
+            from dsml_tpu.serving.paging import copy_page
+
+            self._copy_page = jax.jit(copy_page, donate_argnums=(0,))
+
+            def install_pages_fn(pool, payload, phys):
+                # paged KV handoff install: shipped page payloads land
+                # verbatim at the allocated physical pages
+                return [
+                    {key: c[key].at[phys].set(pl[key]) for key in c}
+                    for c, pl in zip(pool, payload)
+                ]
+
+            self._install_pages = jax.jit(
+                install_pages_fn, donate_argnums=(0,)
+            )
+        elif mesh is None:
             self.params = params
             self._cache = model.init_cache(n_slots)
             # the cache is donated: XLA updates it in place each tick
@@ -667,7 +825,34 @@ class ContinuousBatcher:
                     f"speculative_window-1 ({w - 1}) must fit max_seq="
                     f"{self.model.config.max_seq}"
                 )
-        if not self._chunk_grid_fits(len(prompt)):
+        if self.paged:
+            if not self.prefill_chunk:
+                raise ValueError(
+                    "paged local admission requires prefill_chunk > 0 "
+                    "(decode-only paged workers admit via inject)"
+                )
+            if not self._chunk_grid_fits(len(prompt)):
+                raise ValueError(
+                    f"prompt length {len(prompt)} exceeds the chunk grid for "
+                    f"max_seq={self.model.config.max_seq} (paged admission "
+                    "has no bucketed fallback)"
+                )
+            # never-fits check against the RESERVABLE ceiling: total pages
+            # minus scratch minus the registry's permanent holdings, with
+            # a matched prefix's shared full pages credited — a request
+            # that could only livelock at the FIFO head must fail HERE
+            pre = self._prefixes and self._match_prefix(prompt)
+            p_len = len(pre[0]) if pre else 0
+            need = self._reserve_rows(len(prompt), max_new_tokens, p_len)
+            n_private = -(-need // self.page_size) - p_len // self.page_size
+            ceiling = self.n_pages - 1 - self._registry_pages
+            if n_private > ceiling:
+                raise ValueError(
+                    f"request needs {n_private} private pages but only "
+                    f"{ceiling} are ever reservable ({self._registry_pages} "
+                    "held by the prefix registry); raise n_pages"
+                )
+        elif not self._chunk_grid_fits(len(prompt)):
             # whole-prompt bucketed admission → reject at submit, not admit
             _bucket(len(prompt), self.prompt_buckets)
         if self.max_queue and len(self._queue) >= self.max_queue:
@@ -693,9 +878,11 @@ class ContinuousBatcher:
             "request shed — retry on another replica or back off"
         )
 
-    def inject(self, prompt, max_new_tokens: int, cache1, logits_row,
-               key_rid: int | None = None,
-               submitted_at: float | None = None) -> int:
+    def inject(self, prompt, max_new_tokens: int, cache1=None,
+               logits_row=None, key_rid: int | None = None,
+               submitted_at: float | None = None, *,
+               kv_pages=None, page_size: int | None = None,
+               prefix_rows: int = 0) -> int:
         """Admit a request whose PREFILL already ran elsewhere — the
         decode-worker half of the disaggregated fleet's KV handoff
         (``dsml_tpu.serving.handoff``). ``cache1`` is the 1-row KV cache a
@@ -709,7 +896,16 @@ class ContinuousBatcher:
         tests). ``submitted_at`` carries the ORIGINAL submit time so the
         admission-latency histogram reports true TTFT, queue + prefill +
         handoff included. Sheds with :class:`QueueFull` at ``max_queue``
-        like :meth:`submit` (the router retries on another replica)."""
+        like :meth:`submit` (the router retries on another replica).
+
+        A PAGED worker admits a paged handoff instead: ``kv_pages`` is
+        the shipped page payload (per-layer dicts with a leading
+        shipped-page axis, the pool's own entry layout), ``page_size``
+        the sender's (must match), and ``prefix_rows`` the leading rows
+        NOT shipped because this worker shares its own registered prefix
+        pages for them (copy-on-write — validated here against the local
+        registry so a mismatch fails at the fleet edge, not inside a
+        tick)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("empty prompt")
@@ -717,18 +913,74 @@ class ContinuousBatcher:
             len(prompt), max_new_tokens, self.temperature, self.top_k, self.top_p
         )
         cfg = self.model.config
-        if len(cache1) != cfg.n_layer:
-            raise ValueError(
-                f"handoff cache has {len(cache1)} layers, model has "
-                f"{cfg.n_layer}"
-            )
-        k = cache1[0]["k"]
-        if k.shape[0] != 1 or k.shape[2] != cfg.max_seq:
-            raise ValueError(
-                f"handoff cache rows are {tuple(k.shape)}; expected "
-                f"(1, heads, max_seq={cfg.max_seq}, ...) — prefill and "
-                "decode workers must share the model config"
-            )
+        if self.paged:
+            if kv_pages is None:
+                raise ValueError(
+                    "paged decode worker: inject needs kv_pages= (a dense "
+                    "cache1 cannot land in a page pool)"
+                )
+            if page_size != self.page_size:
+                raise ValueError(
+                    f"handoff pages are {page_size} rows, this pool's are "
+                    f"{self.page_size} — prefill and decode workers must "
+                    "share the page size"
+                )
+            if len(kv_pages) != cfg.n_layer:
+                raise ValueError(
+                    f"handoff has {len(kv_pages)} layers, model has "
+                    f"{cfg.n_layer}"
+                )
+            ref = self._pool[0]
+            for key in ref:
+                arr = kv_pages[0].get(key)
+                if arr is None or tuple(arr.shape[1:]) != tuple(ref[key].shape[1:]):
+                    raise ValueError(
+                        f"handoff page entry {key!r} is "
+                        f"{None if arr is None else tuple(arr.shape)}; pool "
+                        f"pages are {tuple(ref[key].shape)} — quant modes "
+                        "must match"
+                    )
+            if prefix_rows < 0 or prefix_rows % self.page_size or \
+                    prefix_rows > len(prompt):
+                raise ValueError(
+                    f"prefix_rows={prefix_rows} must be a multiple of "
+                    f"page_size={self.page_size} within the prompt"
+                )
+            if prefix_rows:
+                # fail at the fleet edge if no local registration covers
+                # the shared rows (the router replicates registrations, so
+                # this is a deployment bug, not a runtime state)
+                self._registered_prefix_pages(prompt, prefix_rows)
+            n_ship = int(kv_pages[0]["k"].shape[0])
+            rows = self._handoff_rows(len(prompt), max_new_tokens,
+                                      prefix_rows, n_ship)
+            n_private = (-(-rows // self.page_size)
+                         - prefix_rows // self.page_size)
+            ceiling = self.n_pages - 1 - self._registry_pages
+            if n_private > ceiling:
+                raise ValueError(
+                    f"handoff needs {n_private} private pages but only "
+                    f"{ceiling} are ever reservable ({self._registry_pages} "
+                    "held by the prefix registry); raise n_pages"
+                )
+        else:
+            if kv_pages is not None:
+                raise ValueError(
+                    "dense decode worker: got kv_pages= (paged handoffs "
+                    "need a paged_kv batcher)"
+                )
+            if len(cache1) != cfg.n_layer:
+                raise ValueError(
+                    f"handoff cache has {len(cache1)} layers, model has "
+                    f"{cfg.n_layer}"
+                )
+            k = cache1[0]["k"]
+            if k.shape[0] != 1 or k.shape[2] != cfg.max_seq:
+                raise ValueError(
+                    f"handoff cache rows are {tuple(k.shape)}; expected "
+                    f"(1, heads, max_seq={cfg.max_seq}, ...) — prefill and "
+                    "decode workers must share the model config"
+                )
         if self.max_queue and len(self._inject) >= self.max_queue:
             self._shed()
         rid = self._next_rid
@@ -740,7 +992,8 @@ class ContinuousBatcher:
             key_rid=key_rid,
         )
         self._live[rid] = req
-        self._inject.append((req, cache1, np.asarray(logits_row).reshape(-1)))
+        payload = (kv_pages, int(prefix_rows)) if self.paged else cache1
+        self._inject.append((req, payload, np.asarray(logits_row).reshape(-1)))
         return rid
 
     def _admit_injected(self, emitted: dict) -> None:
@@ -750,25 +1003,92 @@ class ContinuousBatcher:
         ``_place_cache1`` untouched, so the host never copies them).
         Handoffs admit BEFORE queued prompts: they already paid their
         prefill, so waiting behind local prefill work would squander the
-        disaggregation win."""
+        disaggregation win. Paged handoffs reserve + install PAGES
+        instead: shared prefix rows resolve to this worker's own
+        registered prefix pages (refcount++, zero bytes moved), shipped
+        pages land verbatim at freshly allocated physical pages, and the
+        decode budget's remaining pages come from the free list — an
+        admission that can't reserve waits in the inject queue."""
+        from dsml_tpu.serving.paging import pages_for
+
         while self._inject:
             free = np.flatnonzero(self._slot_rid == -1)
             if len(free) == 0:
                 return
-            req, cache1, logits_row = self._inject.popleft()
+            if not self.paged:
+                req, cache1, logits_row = self._inject.popleft()
+                slot = int(free[0])
+                self.n_insert_dispatches += 1
+                self._cache = self._insert(
+                    self._cache, self._place_cache1(cache1), jnp.int32(slot)
+                )
+                self._finish_admission(req, slot, logits_row, emitted)
+                continue
+            req, (payload, prefix_rows), logits_row = self._inject[0]  # peek
             slot = int(free[0])
-            self.n_insert_dispatches += 1
-            self._cache = self._insert(
-                self._cache, self._place_cache1(cache1), jnp.int32(slot)
-            )
+            n_ship = int(payload[0]["k"].shape[0])
+            rows = self._handoff_rows(len(req.prompt), req.max_new_tokens,
+                                      prefix_rows, n_ship)
+            n_full = prefix_rows // self.page_size
+            n_private = pages_for(rows, self.page_size) - n_full
+            if not self._pages.can_alloc(n_private):
+                return  # pool full: the handoff waits for retirements
+            shared = (self._registered_prefix_pages(req.prompt, prefix_rows)
+                      if prefix_rows else [])
+            self._pages.share(shared)
+            private = self._pages.alloc(n_private)
+            self._inject.popleft()
+            self._slot_pages[slot] = shared + private
+            self._page_table[slot, :] = 0
+            self._page_table[slot, : len(shared) + len(private)] = shared + private
+            if n_ship:
+                payload_dev = [
+                    {key: jnp.asarray(arr) for key, arr in layer.items()}
+                    for layer in payload
+                ]
+                self.n_insert_dispatches += 1
+                self._pool = self._install_pages(
+                    self._pool, payload_dev,
+                    jnp.asarray(private[:n_ship], jnp.int32),
+                )
             self._finish_admission(req, slot, logits_row, emitted)
+
+    def _registered_prefix_pages(self, prompt: np.ndarray,
+                                 prefix_rows: int) -> list:
+        """The first ``prefix_rows // page_size`` pages of a registered
+        prefix agreeing with ``prompt`` on its first ``prefix_rows``
+        tokens. ANY agreeing registration serves: a page's bytes depend
+        only on the tokens at and before its rows (causality) and the
+        codec is deterministic, so every agreeing prefix holds identical
+        bytes there. ``inject`` validated a match exists."""
+        n_full = prefix_rows // self.page_size
+        for ptoks, ppages, _ in self._prefixes:
+            if len(ptoks) >= prefix_rows and len(ppages) >= n_full and \
+                    np.array_equal(ptoks[:prefix_rows], prompt[:prefix_rows]):
+                return [int(p) for p in ppages[:n_full]]
+        raise RuntimeError(
+            f"no registered prefix covers the handoff's {prefix_rows} shared "
+            "rows — inject validation should have rejected it"
+        )
 
     def register_prefix(self, tokens) -> None:
         """Precompute and retain the KV rows + next-token logits for a
         shared prompt head (a system prompt). Later ``submit``s whose
         prompt starts with the longest registered prefix admit by copying
         these rows and chunk-prefilling only the suffix. Registration is
-        a blocking setup call (it runs the prefix's chunked prefill)."""
+        a blocking setup call (it runs the prefix's chunked prefill).
+
+        On a PAGED batcher the registration IS a page-table entry: the
+        prefix chunk-prefills into pool pages held by the registry
+        (refcount 1, forever), and matching admissions SHARE those pages
+        read-only instead of copying rows — copy-on-write materializes
+        at most the one page a straddling prefix tail makes the slot
+        write into. A paged decode-only worker (``prefill_chunk=0``) may
+        register too — that is how the fleet's decode side holds the
+        prefix pages its paged handoffs reference."""
+        if self.paged:
+            self._register_prefix_paged(tokens)
+            return
         if not self.prefill_chunk:
             raise ValueError("prefix caching requires prefill_chunk > 0")
         tokens = np.asarray(tokens, np.int32).reshape(-1)
@@ -793,6 +1113,35 @@ class ContinuousBatcher:
                 jnp.int32(start), jnp.int32(last_local),
             )
         self._prefixes.append((tokens, cache1, np.asarray(logits[0])))
+        self._prefixes.sort(key=lambda p: -len(p[0]))  # longest match wins
+
+    def _register_prefix_paged(self, tokens) -> None:
+        """Chunk-prefill a prefix into registry-held pool pages. The
+        chunk size is ``prefill_chunk`` when local admission runs here,
+        else ``page_size`` — a quantized pool makes chunk chaining
+        CHUNK-SIZE-INVARIANT (every query reads every key quantized), so
+        pages registered with one chunk size are bit-identical to a
+        prefill worker's at another (pinned in tests). Pages the padded
+        final chunk touches beyond the prefix (pad garbage) are released
+        right back — the registry retains exactly ⌈n/page_size⌉ pages."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = len(tokens)
+        if n < 1:
+            raise ValueError("empty prefix")
+        c = self.prefill_chunk or self.page_size
+        if -(-n // c) * c > self.model.config.max_seq:
+            raise ValueError(
+                f"prefix length {n} exceeds the chunk grid for max_seq="
+                f"{self.model.config.max_seq}"
+            )
+        from dsml_tpu.serving.paging import prefill_prefix_into_pages
+
+        pages, logits, self._pool = prefill_prefix_into_pages(
+            self._prefill_chunk_paged, self.params, self._pool, self._pages,
+            tokens, c, self.page_size, self._n_pt,
+        )
+        self._registry_pages += len(pages)
+        self._prefixes.append((tokens, pages, logits))
         self._prefixes.sort(key=lambda p: -len(p[0]))  # longest match wins
 
     def _match_prefix(self, prompt: np.ndarray):
@@ -868,12 +1217,93 @@ class ContinuousBatcher:
             return False
         return -(-prompt_len // c) * c <= self.model.config.max_seq
 
+    def _handoff_rows(self, prompt_len: int, max_new: int, prefix_rows: int,
+                      n_ship: int) -> int:
+        """Rows a paged HANDOFF admission must reserve pages for: the
+        decode budget (+ speculative overhang) or the shipped+shared page
+        grid, whichever is larger — THE one formula, shared by inject's
+        capacity validation and the actual admission reservation so the
+        two can never disagree."""
+        base = prompt_len + max_new
+        if self.speculative_window:
+            base += self.speculative_window - 1
+        return max(base, prefix_rows + n_ship * self.page_size)
+
+    def _reserve_rows(self, prompt_len: int, max_new: int,
+                      prefix_len: int) -> int:
+        """Rows a paged admission must reserve pages for — everything the
+        request can EVER write: the padded prefill chunk grid (pad rows of
+        the final chunk land in pages too), the decode budget, and the
+        speculative verify window's overhang. Reserving up front is what
+        makes decode/verify allocation-free mid-flight (docs/SERVING.md)."""
+        base = prompt_len + max_new
+        if self.speculative_window:
+            base += self.speculative_window - 1
+        c = self.prefill_chunk or self.page_size
+        grid_end = prefix_len + -(-(prompt_len - prefix_len) // c) * c \
+            if prompt_len > prefix_len else prompt_len
+        return min(self.model.config.max_seq, max(base, grid_end))
+
+    def _assign_slot_pages(self, slot: int, plan) -> None:
+        """Install an admission plan's pages as ``slot``'s page table (and
+        run its CoW straddle copy, counting it)."""
+        self._slot_pages[slot] = list(plan.pages)
+        self._page_table[slot, :] = 0
+        self._page_table[slot, : len(plan.pages)] = plan.pages
+        if plan.copy is not None:
+            src, dst = plan.copy
+            self._pool = self._copy_page(
+                self._pool, jnp.int32(src), jnp.int32(dst)
+            )
+            self.n_cow_copies += 1
+            self._obs.counter(
+                "serving_cow_copies_total",
+                "prefix pages materialized privately on first write",
+                labels=("replica", "role"),
+            ).inc(replica=self.obs_replica, role=self.obs_role)
+
+    def _decode_table(self) -> np.ndarray:
+        """The page table a decode/verify dispatch may see: ACTIVE slots'
+        rows only. A pending chunked admission's slot already owns its
+        reserved pages (the chunk program writes them), but the decode
+        program also writes a (masked, never-read) garbage row for every
+        non-active slot — routed to the scratch page here, so a decode
+        tick interleaving with a mid-flight admission can never clobber
+        its freshly prefilled rows (the paged twin of the dense path's
+        separate accumulating cache1; regression-pinned)."""
+        return np.where((self._slot_rid >= 0)[:, None], self._page_table, 0)
+
+    def _free_slot_pages(self, slot: int) -> None:
+        """Release a slot's pages back to the pool (retire/abandon path);
+        its table row points back at the scratch page so the decode
+        program's dead-slot writes stay harmless. No-op for dense."""
+        if not self.paged:
+            return
+        pages = self._slot_pages[slot]
+        if pages:
+            self._pages.release(pages)
+        self._slot_pages[slot] = []
+        self._page_table[slot, :] = 0
+
+    @property
+    def free_pages(self) -> int:
+        return self._pages.free_pages if self.paged else 0
+
+    @property
+    def used_pages(self) -> int:
+        return self._pages.used_pages if self.paged else 0
+
+    @property
+    def shared_pages(self) -> int:
+        return self._pages.shared_pages if self.paged else 0
+
     def _occupy(self, req: Request, slot: int, tok: int) -> None:
         """Install an admitted (not-yet-finished) request into its slot."""
         self._slot_rid[slot] = req.rid
         self._pos[slot] = len(req.prompt)
         self._last_tok[slot] = tok
         self._slot_key[slot] = np.asarray(self._req_key(req))
+        self._slot_accept[slot] = np.nan  # a fresh request, a fresh EWMA
 
     def _finish_admission(self, req: Request, slot: int, logits_row, emitted: dict) -> None:
         """THE admission epilogue — shared by whole-prompt, chunked, and
@@ -902,6 +1332,7 @@ class ContinuousBatcher:
         if self._finished(req, tok):
             self._retire(req)
             self._slot_rid[slot] = -1  # release any reservation
+            self._free_slot_pages(slot)
             return
         self._occupy(req, slot, tok)
 
@@ -1007,6 +1438,88 @@ class ContinuousBatcher:
             self._slot_rid[slot] = -2  # reserve: not free, not decoding
             self._pending = (req, slot, self._fresh_cache1(), 0)
 
+    def _admit_paged(self) -> dict[int, list]:
+        """Paged admission pass — ``_admit_chunked`` with pages: advance
+        the in-flight admission by ONE chunk; otherwise reserve a page
+        plan for the queue head (shared prefix pages + CoW straddle +
+        fresh pages for the whole prompt-grid/decode/window footprint)
+        and start it. A head that cannot reserve WAITS — retirements free
+        pages, and FIFO order keeps the wait fair. Exact-prefix hits
+        admit with zero prefill dispatches: the shared page-table entry
+        (plus at most one CoW page copy) IS the admission."""
+        from dsml_tpu.serving.paging import plan_admission
+
+        emitted: dict[int, list] = {}
+        while True:
+            if self._pending is not None:
+                if not self._advance_pending_paged(emitted):
+                    return emitted  # long admission mid-flight: decode now
+                continue
+            free = np.flatnonzero(self._slot_rid == -1)
+            if len(free) == 0 or not self._queue:
+                return emitted
+            req = self._queue[0]  # peek: pop only once pages are reserved
+            L = len(req.prompt)
+            slot = int(free[0])
+            pre = self._prefixes and self._match_prefix(req.prompt)
+            ptoks, ppages, plogits = pre if pre else (None, None, None)
+            p_len = len(ptoks) if pre else 0
+            plan = plan_admission(
+                self._pages, self.page_size,
+                self._reserve_rows(L, req.max_new_tokens, p_len),
+                prefix_pages=ppages, prefix_len=p_len,
+            )
+            if plan is None:
+                if (self._pages.used_pages == self._registry_pages
+                        and self.n_active == 0 and not self._inject):
+                    # the pool is as empty as it will ever get and the
+                    # head still can't reserve — a prefix registered
+                    # AFTER this submit shrank the ceiling past it. Fail
+                    # loudly instead of livelocking the FIFO (submit()'s
+                    # never-fits check guards the normal order).
+                    raise RuntimeError(
+                        f"request {req.rid} can never reserve its pages "
+                        f"({self._registry_pages} held by the prefix "
+                        "registry); register prefixes before accepting "
+                        "traffic, or raise n_pages"
+                    )
+                return emitted  # pool full: wait for retirements
+            self._queue.popleft()
+            self._assign_slot_pages(slot, plan)
+            if pre and p_len == L:
+                # the whole prompt is the registered prefix: admission
+                # completes with zero prefill work and zero row copies
+                self._finish_admission(req, slot, plogits, emitted)
+                continue
+            self._slot_rid[slot] = -2  # reserve: not free, not decoding
+            self._pending = (req, slot, p_len)
+
+    def _advance_pending_paged(self, emitted: dict) -> bool:
+        """Run ONE chunk of the in-flight paged admission — the chunk
+        writes straight into the slot's reserved pool pages (no side
+        cache, no final insert dispatch). Returns True when the admission
+        completed this call."""
+        req, slot, start = self._pending
+        c = self.prefill_chunk
+        L = len(req.prompt)
+        end = min(start + c, L)
+        padded = np.zeros((1, c), np.int32)
+        padded[0, : end - start] = req.prompt[start:end]
+        is_last = end >= L
+        last_local = (L - 1) - start if is_last else c - 1
+        table_row = jnp.asarray(self._page_table[slot : slot + 1])
+        logits, self._pool = self._prefill_chunk_paged(
+            self.params, self._pool, table_row, jnp.asarray(padded),
+            jnp.int32(start), jnp.int32(last_local),
+        )
+        self.n_prefill_dispatches += 1
+        if not is_last:
+            self._pending = (req, slot, start + c)
+            return False
+        self._pending = None
+        self._finish_admission(req, slot, logits[0], emitted)
+        return True
+
     def _finished(self, req: Request, tok: int) -> bool:
         return (self.eos_id is not None and tok == self.eos_id) or (
             len(req.tokens) >= req.max_new_tokens
@@ -1106,6 +1619,14 @@ class ContinuousBatcher:
                 labels=("replica", "role"),
             ).inc(sum(len(t) for t in emitted.values()),
                   replica=self.obs_replica, role=self.obs_role)
+            if self.paged:
+                # pool occupancy + free-list gauges: the capacity signal
+                # behind "should this deployment raise n_pages" and the
+                # live CoW sharing the prefix registry is buying
+                from dsml_tpu.serving.paging import export_pool_gauges
+
+                export_pool_gauges(self._obs, self._pages,
+                                   self.obs_replica, self.obs_role)
         return emitted
 
     def _step_inner(self) -> dict[int, list]:
@@ -1114,9 +1635,12 @@ class ContinuousBatcher:
             self._admit_injected(emitted)
         # handed-off and local admissions touch disjoint rids, so a plain
         # merge cannot clobber an emission list
-        emitted.update(
-            self._admit_chunked() if self.prefill_chunk else self._admit()
-        )
+        if self.paged:
+            emitted.update(self._admit_paged())
+        else:
+            emitted.update(
+                self._admit_chunked() if self.prefill_chunk else self._admit()
+            )
         active = np.flatnonzero(self._slot_rid >= 0)
         if len(active) == 0:
             return emitted
@@ -1126,6 +1650,18 @@ class ContinuousBatcher:
             [len(self._live[rid].tokens) if rid >= 0 else 0 for rid in self._slot_rid],
             np.int32,
         )
+        if self.paged:
+            # paged decode tick: the page table rides along; writes scatter
+            # into each slot's reserved pages (free slots' into scratch)
+            toks, self._pool = self._decode_paged(
+                self.params, self._pool, jnp.asarray(self._decode_table()),
+                jnp.asarray(self._last_tok), jnp.asarray(self._pos),
+                jnp.asarray(self._slot_key), jnp.asarray(steps_done),
+            )
+            self.n_plain_ticks += 1
+            return self._apply_decoded(
+                emitted, active, np.asarray(toks), self.decode_quantum
+            )
         # adaptive early-exit tick: one dispatch decodes until any active
         # slot finishes (or k_max) — engaged whenever no chunked admission
         # is mid-flight (those need the plain quantum's chunk interleave).
@@ -1203,6 +1739,7 @@ class ContinuousBatcher:
                 if self._finished(req, tok):
                     self._retire(req)
                     self._slot_rid[slot] = -1  # freed → next admit reuses it
+                    self._free_slot_pages(slot)
                     break
             if self._slot_rid[slot] >= 0:  # request continues
                 self._pos[slot] += quantum
@@ -1219,13 +1756,64 @@ class ContinuousBatcher:
                 self._last_tok[slot] = int(toks[-1, slot])
         return emitted
 
+    def _active_accept_ewma(self) -> float | None:
+        """The adaptive window's acceptance signal: the mean of ACTIVE
+        slots' per-slot EWMAs — the requests actually in flight set the
+        width, not a retired request's stale rate — falling back to the
+        batcher-level EWMA while no active slot has a measurement yet
+        (fresh admissions), and None before any measurement at all."""
+        active = self._slot_accept[self._slot_rid >= 0]
+        vals = active[~np.isnan(active)]
+        if len(vals):
+            return float(vals.mean())
+        return self.accept_ewma
+
+    def _spec_window_for_tick(self) -> int:
+        """This tick's verify-window width. Fixed at ``speculative_window``
+        unless ``speculative_adaptive``: then the width tracks the
+        measured acceptance (:meth:`_active_accept_ewma`) — one draft
+        beyond the expected accepted count, floored at 2, capped at the
+        configured max — so a workload whose drafts stop landing stops
+        paying for wide verify windows (each window column is verify
+        FLOPs + cache-read bandwidth), and one whose drafts land climbs
+        back to the full window. Greedy tokens are IDENTICAL at any width
+        (each tick commits the model's own greedy chain), so adapting is
+        pure scheduling — pinned in tests. Starts at the max width
+        (optimistic) until the first acceptance measurement lands."""
+        w_max = self.speculative_window
+        if not self.speculative_adaptive:
+            return w_max
+        acc = self._active_accept_ewma()
+        if acc is None:
+            return w_max
+        expected = 1.0 + acc * (w_max - 1)
+        return max(2, min(w_max, int(np.ceil(expected)) + 1))
+
+    def predicted_tpot_s(self) -> float | None:
+        """Acceptance-aware per-token decode latency prediction: the
+        measured verify-tick wall EWMA over the measured
+        committed-tokens-per-slot-tick EWMA. None until both are warm (or
+        when not speculating) — the router then falls back to its
+        harvested TPOT EWMA. This is how per-slot acceptance feeds the
+        SLO router's cost model: a worker whose drafts stop landing gets
+        expensive BEFORE its harvested TPOT catches up."""
+        if (not self.speculative_window or self.spec_tick_s_ewma is None
+                or not self.commit_ewma):
+            return None
+        return self.spec_tick_s_ewma / max(self.commit_ewma, 1.0)
+
     def _step_speculative(self, emitted: dict, active) -> dict[int, list]:
-        """One speculative tick: per-slot prompt-lookup drafts (host-side),
-        ONE ``verify_step`` call over all slots at their own depths, then
-        per-slot greedy-chain acceptance — each active slot commits
-        1..window tokens. Inactive slots carry a dummy window at position 0
-        whose garbage cache rows the admission insert fully overwrites."""
-        w = self.speculative_window
+        """One speculative tick: per-slot prompt-lookup drafts (host-side,
+        the shared ``models.speculative`` rule), ONE verify call over all
+        slots at their own depths (``verify_step`` dense /
+        ``verify_step_paged`` through the page table), then per-slot
+        greedy-chain acceptance — each active slot commits 1..w tokens.
+        Inactive slots carry a dummy window at position 0 whose garbage
+        rows land in their own dead cache rows (dense) or the scratch
+        page (paged) and are never read. Acceptance-rate EWMAs update
+        per slot here — the adaptive window and the router's TPOT cost
+        model both feed on them."""
+        w = self._spec_window_for_tick()
         toks = np.zeros((self.n_slots, w), np.int32)
         pos = np.zeros(self.n_slots, np.int32)
         for slot in active:
@@ -1236,15 +1824,31 @@ class ContinuousBatcher:
             toks[slot, 0] = self._last_tok[slot]
             toks[slot, 1:] = _lookup_draft(history, self.speculative_ngram, w - 1)
             pos[slot] = self._pos[slot]
-        logits, self._cache = self._verify(
-            self.params, self._cache, jnp.asarray(toks), jnp.asarray(pos)
-        )
+        t0 = time.monotonic()
+        if self.paged:
+            logits, self._pool = self._verify_paged(
+                self.params, self._pool, jnp.asarray(self._decode_table()),
+                jnp.asarray(toks), jnp.asarray(pos),
+            )
+        else:
+            logits, self._cache = self._verify(
+                self.params, self._cache, jnp.asarray(toks), jnp.asarray(pos)
+            )
         greedy = np.asarray(jnp.argmax(logits, axis=-1))  # [n_slots, W]
+        wall = time.monotonic() - t0  # greedy pull forced the dispatch
+        self.n_spec_ticks += 1
+        self.spec_window_used[w] = self.spec_window_used.get(w, 0) + 1
+        self.spec_tick_s_ewma = (
+            wall if self.spec_tick_s_ewma is None
+            else 0.8 * self.spec_tick_s_ewma + 0.2 * wall
+        )
+        committed_total = 0
         for slot in active:
             req = self._live[int(self._slot_rid[slot])]
             new = emitted.setdefault(req.rid, [])
             drafts = toks[slot, 1:]
             committed = 0
+            measured = True  # False when retirement censors the window
             for i in range(w):
                 # greedy[i] is the model's next token after consuming window
                 # position i — valid iff every draft before it matched the
@@ -1257,9 +1861,26 @@ class ContinuousBatcher:
                 if self._finished(req, tok):
                     self._retire(req)
                     self._slot_rid[slot] = -1  # freed → next admit reuses it
+                    self._free_slot_pages(slot)
+                    # EOS/budget cut the window short: the unconsumed
+                    # drafts were never judged, so this tick is not an
+                    # acceptance sample (unless the window was already
+                    # fully accepted)
+                    measured = committed == w
                     break
                 if i == w - 1 or int(drafts[i]) != tok:
                     break  # draft diverged (or window exhausted): stop here
+            committed_total += committed
+            if measured and w > 1:
+                rate = (committed - 1) / (w - 1)
+                prev = self._slot_accept[slot]
+                self._slot_accept[slot] = (
+                    rate if np.isnan(prev) else 0.8 * prev + 0.2 * rate
+                )
+                self.accept_ewma = (
+                    rate if self.accept_ewma is None
+                    else 0.8 * self.accept_ewma + 0.2 * rate
+                )
             if self._slot_rid[slot] >= 0:  # request continues
                 self._pos[slot] += committed
                 # the next verify window writes rows pos..pos+W-1; submit()'s
@@ -1268,6 +1889,18 @@ class ContinuousBatcher:
                     f"slot {slot} verify window would escape max_seq="
                     f"{self.model.config.max_seq}"
                 )
+        mean_commit = committed_total / len(active)
+        self.commit_ewma = (
+            mean_commit if self.commit_ewma is None
+            else 0.8 * self.commit_ewma + 0.2 * mean_commit
+        )
+        if self._obs.enabled and self.accept_ewma is not None:
+            self._obs.gauge(
+                "serving_spec_accept_rate",
+                "speculative draft acceptance rate (EWMA)",
+                labels=("replica", "role"),
+            ).set(self.accept_ewma, replica=self.obs_replica,
+                  role=self.obs_role)
         return emitted
 
     def abandon(self) -> list[Request]:
@@ -1289,6 +1922,14 @@ class ContinuousBatcher:
         self._slot_rid[:] = -1
         self._pos[:] = 0
         self._last_tok[:] = 0
+        self._slot_accept[:] = np.nan
+        if self.paged:
+            # every slot's pages return to the pool (registered prefix
+            # pages keep the registry's reference and SURVIVE — they are
+            # this worker's setup state, not a request's) — the no-leak
+            # invariant the chaos smoke asserts after a replica kill
+            for slot in range(self.n_slots):
+                self._free_slot_pages(slot)
         if self._obs.enabled:
             from dsml_tpu.obs import flight_recorder
 

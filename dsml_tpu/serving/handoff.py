@@ -78,6 +78,16 @@ class Handoff:
     submitted_at: float = 0.0
     prefill_done_at: float = 0.0
     key_rid: int | None = None
+    # PAGED handoff (docs/SERVING.md § Paged KV): when ``page_size`` is
+    # set, ``cache1`` holds the shipped PAGES instead — per-layer dicts
+    # with a leading shipped-page axis [n_ship, H, page_size, ·] in the
+    # decode pool's own (quantized) entry layout, so the wire carries
+    # int4 pages (~8x fewer bytes than dense f32 rows) and the decode
+    # worker installs them verbatim. ``prefix_rows`` leading rows are NOT
+    # shipped: the decode worker shares its own registered prefix pages
+    # for them (the fleet-level CoW elision; always a page multiple).
+    page_size: int | None = None
+    prefix_rows: int = 0
 
 
 def _leaves(cache1) -> list:
@@ -127,6 +137,8 @@ def encode_handoff(handoff: Handoff) -> dict:
         "submitted_at": float(handoff.submitted_at),
         "prefill_done_at": float(handoff.prefill_done_at),
         "n_layers": len(handoff.cache1),
+        "page_size": handoff.page_size,
+        "prefix_rows": int(handoff.prefix_rows),
         "leaves": leaves,
         "logits_nbytes": len(parts[-1]),
         "total_nbytes": len(payload),
@@ -183,6 +195,8 @@ def decode_handoff(frame: dict, validate: bool = True) -> Handoff:
         submitted_at=float(header["submitted_at"]),
         prefill_done_at=float(header["prefill_done_at"]),
         key_rid=header.get("key_rid"),
+        page_size=header.get("page_size"),
+        prefix_rows=int(header.get("prefix_rows", 0)),
     )
 
 
@@ -235,6 +249,8 @@ def register_with_donor(donor, handoff: Handoff, prefix: str | None = None) -> d
         "submitted_at": float(handoff.submitted_at),
         "prefill_done_at": float(handoff.prefill_done_at),
         "n_layers": len(handoff.cache1),
+        "page_size": handoff.page_size,
+        "prefix_rows": int(handoff.prefix_rows),
         "leaves": leaves,
         "logits_nbytes": int(logits.nbytes),
         "total_nbytes": total + int(logits.nbytes),
@@ -272,4 +288,6 @@ def fetch_from_migrator(migrator, descriptor: dict) -> Handoff:
         submitted_at=float(header["submitted_at"]),
         prefill_done_at=float(header["prefill_done_at"]),
         key_rid=header.get("key_rid"),
+        page_size=header.get("page_size"),
+        prefix_rows=int(header.get("prefix_rows", 0)),
     )

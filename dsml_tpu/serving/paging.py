@@ -1,0 +1,234 @@
+"""Host-side page-pool accounting for the paged KV cache.
+
+The device side of paged serving is three model programs
+(``decode_step_slots_paged`` / ``prefill_chunk_paged`` /
+``verify_step_paged`` — gathered attention through a page table, scatter
+writes at (physical page, row)). This module is the HOST side those
+programs trust: a refcounting allocator over the physical pages and the
+copy-on-write admission planner. Two invariants carry the whole design:
+
+- **Writes only land in private pages.** The planner shares only the
+  FULL pages of a matched prefix (rows ``[0, ⌊P/page⌋·page)``); a prefix
+  whose tail straddles a page boundary gets that one page materialized
+  privately (``copy``), because the suffix prefill — and later decode —
+  writes into it. Everything past the prefix is freshly allocated. So a
+  shared page is read-only by construction, and refcounts only ever
+  gate RECLAMATION, never correctness.
+- **Reservation up front, zero mid-flight preemption.** Admission
+  reserves every page the request can EVER touch (prompt grid + decode
+  budget + speculative window) before the first chunk runs; a request
+  that can't reserve waits in the queue. Decode therefore never runs
+  out of pages mid-flight — the simple scheduler stays simple, and the
+  capacity story is still 4-8× (int4 rows + right-sized reservation vs
+  a dense max_seq slot; docs/TUNING.md has the accounting).
+
+Page 0 is the SCRATCH page: never allocated, named by every free/retired
+slot's table entries, so a dead slot's (masked, never-read) writes can't
+corrupt a live slot's pages.
+
+Shared by ``ContinuousBatcher`` (decode role) and ``PrefillWorker`` so
+the two ends of a paged KV handoff cannot drift on allocation rules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+
+import numpy as np
+
+__all__ = [
+    "PagePool",
+    "AdmissionPlan",
+    "pages_for",
+    "plan_admission",
+    "copy_page",
+    "prefill_prefix_into_pages",
+    "export_pool_gauges",
+]
+
+
+def pages_for(rows: int, page_size: int) -> int:
+    """Physical pages needed to hold ``rows`` token rows."""
+    return -(-int(rows) // int(page_size))
+
+
+class PagePool:
+    """Refcounting free-list allocator over ``n_pages`` physical pages.
+
+    Page 0 is reserved as the scratch page and never handed out. ``alloc``
+    gives fresh pages at refcount 1; ``share`` bumps an already-owned
+    page (the CoW prefix path); ``release`` drops one reference and
+    returns the page to the free list when the count hits zero. The pool
+    raises on double-free/over-release — an allocator bug must crash the
+    test that found it, not silently corrupt a neighbor's cache rows.
+    """
+
+    def __init__(self, n_pages: int):
+        if n_pages < 2:
+            raise ValueError(
+                f"need n_pages >= 2 (page 0 is the scratch page), got {n_pages}"
+            )
+        self.n_pages = int(n_pages)
+        self._free: deque[int] = deque(range(1, self.n_pages))
+        self._ref = np.zeros(self.n_pages, np.int32)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.n_pages - 1 - len(self._free)
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages currently referenced by MORE than one owner — the live
+        CoW sharing the occupancy gauges report."""
+        return int((self._ref > 1).sum())
+
+    def refcount(self, page: int) -> int:
+        return int(self._ref[page])
+
+    def can_alloc(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def alloc(self, n: int) -> list[int]:
+        if n < 0:
+            raise ValueError(f"cannot alloc {n} pages")
+        if len(self._free) < n:
+            raise RuntimeError(
+                f"page pool exhausted: {n} requested, {len(self._free)} free "
+                f"of {self.n_pages} — callers must check can_alloc and wait"
+            )
+        pages = [self._free.popleft() for _ in range(n)]
+        self._ref[pages] = 1
+        return pages
+
+    def share(self, pages) -> None:
+        pages = list(pages)
+        if any(self._ref[p] < 1 for p in pages):
+            raise RuntimeError(f"sharing unowned page(s) in {pages}")
+        for p in pages:
+            self._ref[p] += 1
+
+    def release(self, pages) -> None:
+        for p in pages:
+            if p == 0 or self._ref[p] < 1:
+                raise RuntimeError(f"releasing free/scratch page {p}")
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._free.append(int(p))
+
+
+@dataclasses.dataclass
+class AdmissionPlan:
+    """One admission's page assignment. ``pages`` is the slot's table
+    prefix in order (shared prefix pages first, then private); the first
+    ``n_shared`` entries are read-only shared pages; ``copy`` is the one
+    (src, dst) CoW materialization when the prefix tail straddles a page
+    boundary (dst is ``pages[n_shared]``), else None."""
+
+    pages: list
+    n_shared: int
+    copy: tuple | None
+
+
+def plan_admission(pool: PagePool, page_size: int, total_rows: int,
+                   prefix_pages=None, prefix_len: int = 0,
+                   share_prefix: bool = True) -> AdmissionPlan | None:
+    """Plan a request's page reservation: share the matched prefix's full
+    pages, privately materialize a straddling prefix tail page, allocate
+    the rest fresh. ``total_rows`` must cover everything the request can
+    ever write (prompt chunk grid, decode budget, speculative window —
+    the caller computes it). Returns None when the pool cannot serve the
+    reservation right now (the request waits); the plan is applied
+    atomically — on None, no counts changed. ``share_prefix=False``
+    plans the same page count without sharing (the A/B baseline the
+    CoW win is measured against)."""
+    n_need = pages_for(total_rows, page_size)
+    n_full = 0
+    straddle = None
+    if prefix_pages is not None and prefix_len > 0 and share_prefix:
+        n_full = min(int(prefix_len) // int(page_size), len(prefix_pages))
+        if prefix_len % page_size and n_full < len(prefix_pages):
+            straddle = int(prefix_pages[n_full])
+    n_private = n_need - n_full
+    if n_private < 0:
+        # a prefix longer than the reservation can't happen (the caller's
+        # total_rows includes the whole prompt + decode budget, and the
+        # matched prefix is a prompt prefix) — fail loudly if it does
+        raise ValueError(
+            f"prefix covers {n_full} pages but the request reserves only "
+            f"{n_need}"
+        )
+    if not pool.can_alloc(n_private):
+        return None
+    shared = [int(p) for p in (prefix_pages[:n_full] if n_full else [])]
+    private = pool.alloc(n_private)
+    pool.share(shared)
+    copy = (straddle, private[0]) if (straddle is not None and n_private) else None
+    return AdmissionPlan(pages=shared + private, n_shared=n_full, copy=copy)
+
+
+def copy_page(pool, src, dst):
+    """Duplicate one physical page across every layer/entry — the CoW
+    materialization of a straddling prefix tail. THE one copy kernel:
+    batcher and prefill worker both jit this (``donate_argnums=(0,)``),
+    so the two ends of a paged fleet cannot drift on copy semantics."""
+    return [
+        {key: a.at[dst].set(a[src]) for key, a in c.items()}
+        for c in pool
+    ]
+
+
+def prefill_prefix_into_pages(chunk, params, pool, allocator, tokens,
+                              chunk_size: int, page_size: int, n_pt: int):
+    """Chunk-prefill a PREFIX into freshly allocated registry pages — THE
+    one paged-registration algorithm (batcher decode role and prefill
+    worker both register through here; fleet-level CoW elision rests on
+    both ends' registry pages being byte-identical). ``chunk`` is the
+    caller's jitted paged-chunk program ``(params, pool, table, padded,
+    start, last) -> (logits, pool)``. Pages the padded final chunk
+    touches beyond the prefix (pad garbage) are released right back —
+    the registry keeps exactly ⌈len(tokens)/page_size⌉ pages. Returns
+    ``(kept_pages, last_logits, new_pool)``; raises RuntimeError when
+    the pool cannot stage the chunk grid."""
+    n = len(tokens)
+    grid_end = -(-n // chunk_size) * chunk_size
+    n_keep = pages_for(n, page_size)
+    n_grid = pages_for(grid_end, page_size)
+    if not allocator.can_alloc(n_grid):
+        raise RuntimeError(
+            f"page pool too full to register a {n}-token prefix "
+            f"({n_grid} pages needed, {allocator.free_pages} free)"
+        )
+    pages = allocator.alloc(n_grid)
+    table = np.zeros((1, n_pt), np.int32)
+    table[0, :n_grid] = pages
+    logits = None
+    for start in range(0, n, chunk_size):
+        end = min(start + chunk_size, n)
+        padded = np.zeros((1, chunk_size), np.int32)
+        padded[0, : end - start] = tokens[start:end]
+        last_local = (n - 1) - start if end >= n else chunk_size - 1
+        logits, pool = chunk(params, pool, table, padded,
+                             np.int32(start), np.int32(last_local))
+    if n_grid > n_keep:  # pad-only pages hold nothing shareable
+        allocator.release(pages[n_keep:])
+    return pages[:n_keep], np.asarray(logits[0]), pool
+
+
+def export_pool_gauges(obs, pool: PagePool, replica: str, role: str) -> None:
+    """The (replica, role)-labeled occupancy/free-list/CoW gauges every
+    paged worker exports per tick (docs/OBSERVABILITY.md)."""
+    for name, help_, value in (
+        ("serving_page_pool_used", "pool pages in use", pool.used_pages),
+        ("serving_page_pool_free", "pool pages on the free list",
+         pool.free_pages),
+        ("serving_page_pool_shared", "pages shared by >1 owner (CoW)",
+         pool.shared_pages),
+    ):
+        obs.gauge(name, help_, labels=("replica", "role")).set(
+            value, replica=replica, role=role
+        )

@@ -66,7 +66,8 @@ class PrefillWorker:
     wall EWMA)."""
 
     def __init__(self, model, params, prefill_chunk: int,
-                 max_queue: int = 0):
+                 max_queue: int = 0, paged_kv=False, page_size: int = 16,
+                 n_pages: int = 0):
         cfg = model.config
         if not 0 < prefill_chunk <= cfg.max_seq:
             raise ValueError(
@@ -84,15 +85,62 @@ class PrefillWorker:
         self._obs = get_registry()
         self._queue: deque[_Job] = deque()
         self._queued_tokens = 0  # running sum of queued jobs' eff_tokens
-        # the in-flight job: (job, accumulating 1-row cache, next start)
+        # the in-flight job: (job, accumulating 1-row cache, next start) —
+        # paged: (job, AdmissionPlan, next start)
         self._pending: tuple | None = None
-        self._prefixes: list = []  # (tokens, cache1, last_logits) len-desc
+        self._prefixes: list = []  # (tokens, cache1|pages, last_logits) len-desc
         self._next_frid = 0
         # measured per-chunk wall EWMA (seconds) — the router's prefill
         # cost model; seeded by the first real chunk
         self.chunk_s_ewma: float | None = None
         self.n_chunk_dispatches = 0
         self.n_handoffs = 0
+
+        # ---- paged mode: prefill INTO pool pages, hand off the pages ----
+        # (the paged fleet's prefill half: the handoff ships int4 pages —
+        # ~8x fewer wire bytes than dense f32 rows — and a matched prefix
+        # can be elided entirely when the decode side shares its own
+        # registered prefix pages; the Router flips ship_prefix_pages on
+        # once it has replicated every registration fleet-wide)
+        self.page_quant = (None if paged_kv == "fp"
+                           else model._page_mode(paged_kv))
+        self.paged = bool(paged_kv)
+        self.page_size = int(page_size)
+        self.ship_prefix_pages = False
+        if self.paged:
+            if cfg.max_seq % self.page_size:
+                raise ValueError(
+                    f"page_size must divide max_seq={cfg.max_seq}, got "
+                    f"{self.page_size}"
+                )
+            self._n_pt = cfg.max_seq // self.page_size
+            # auto-size: one full-length job in flight + one more + scratch;
+            # registrations eat into this — size n_pages for the prefix set
+            self.n_pages = int(n_pages) or 2 * self._n_pt + 1
+            from dsml_tpu.serving.paging import PagePool
+
+            self._pages = PagePool(self.n_pages)
+            self.n_cow_copies = 0
+            pq = self.page_quant
+            self._pool = model.init_page_pool(
+                self.n_pages, self.page_size, quant=pq
+            )
+
+            def chunk_paged_fn(p, pool, table, toks, start, last):
+                return model.prefill_chunk_paged(
+                    p, pool, table, toks, start, None, last_index=last,
+                    quant=pq,
+                )
+
+            self._chunk_paged = jax.jit(chunk_paged_fn, donate_argnums=(1,))
+            from dsml_tpu.serving.paging import copy_page
+
+            self._copy_page = jax.jit(copy_page, donate_argnums=(0,))
+            # pages the prefix registry holds forever (the never-fits
+            # checks subtract these from the reservable ceiling)
+            self._registry_pages = 0
+        else:
+            self.n_pages = 0
 
         def chunk_fn(p, c, toks, start, last):
             return model.prefill_chunk(p, c, toks, start, None, last_index=last)
@@ -122,6 +170,25 @@ class PrefillWorker:
                 f"prompt length {len(prompt)} exceeds the chunk grid for "
                 f"max_seq={self.model.config.max_seq}"
             )
+        if self.paged:
+            # never-fits check against the reservable ceiling (pool minus
+            # scratch minus registry holdings, matched prefix's shared
+            # pages credited) — a job that could only park at the queue
+            # head forever must fail at submit, not wedge the worker
+            from dsml_tpu.serving.paging import pages_for
+
+            pre0 = self._match_prefix(prompt) if self._prefixes else None
+            p0 = len(pre0[0]) if pre0 else 0
+            grid = (p0 + -(-(len(prompt) - p0) // self.prefill_chunk)
+                    * self.prefill_chunk) if len(prompt) > p0 else len(prompt)
+            n_private = pages_for(grid, self.page_size) - p0 // self.page_size
+            ceiling = self.n_pages - 1 - self._registry_pages
+            if n_private > ceiling:
+                raise ValueError(
+                    f"prefill job needs {n_private} private pages but only "
+                    f"{ceiling} are ever reservable ({self._registry_pages} "
+                    "held by the prefix registry); raise n_pages"
+                )
         if self.max_queue and len(self._queue) >= self.max_queue:
             self._obs.counter(
                 "serving_shed_total", "requests rejected by the queue cap",
@@ -148,7 +215,12 @@ class PrefillWorker:
     def register_prefix(self, tokens) -> None:
         """Precompute + retain KV rows and next-token logits for a shared
         prompt head — the batcher's ``register_prefix``, prefill-side.
-        Blocking setup call (runs the prefix's chunked prefill now)."""
+        Blocking setup call (runs the prefix's chunked prefill now). On a
+        paged worker the registration is a page-table entry: the prefix
+        lands in registry-held pool pages that matching jobs SHARE during
+        their suffix prefill (CoW — only a straddling tail page is ever
+        copied), and that paged handoffs elide when the decode side
+        shares its own registration (``ship_prefix_pages``)."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         n = len(tokens)
         if n < 1:
@@ -159,18 +231,28 @@ class PrefillWorker:
                 f"{self.model.config.max_seq}"
             )
         c = self.prefill_chunk
-        cache1 = self._fresh_cache1()
-        logits = None
-        for start in range(0, n, c):
-            end = min(start + c, n)
-            padded = np.zeros((1, c), np.int32)
-            padded[0, : end - start] = tokens[start:end]
-            last_local = (n - 1) - start if end >= n else c - 1
-            logits, cache1 = self._chunk(
-                self.params, cache1, jnp.asarray(padded),
-                jnp.int32(start), jnp.int32(last_local),
+        if self.paged:
+            from dsml_tpu.serving.paging import prefill_prefix_into_pages
+
+            pages, logits, self._pool = prefill_prefix_into_pages(
+                self._chunk_paged, self.params, self._pool, self._pages,
+                tokens, c, self.page_size, self._n_pt,
             )
-        self._prefixes.append((tokens, cache1, np.asarray(logits[0])))
+            self._registry_pages += len(pages)
+            self._prefixes.append((tokens, pages, logits))
+        else:
+            cache1 = self._fresh_cache1()
+            logits = None
+            for start in range(0, n, c):
+                end = min(start + c, n)
+                padded = np.zeros((1, c), np.int32)
+                padded[0, : end - start] = tokens[start:end]
+                last_local = (n - 1) - start if end >= n else c - 1
+                logits, cache1 = self._chunk(
+                    self.params, cache1, jnp.asarray(padded),
+                    jnp.int32(start), jnp.int32(last_local),
+                )
+            self._prefixes.append((tokens, cache1, np.asarray(logits[0])))
         self._prefixes.sort(key=lambda p: -len(p[0]))  # longest match wins
         # re-stamp queued jobs' effective tokens: the new prefix may cover
         # prompts submitted before it registered (setup-time cost only)
@@ -228,12 +310,85 @@ class PrefillWorker:
 
     # ---- scheduling ------------------------------------------------------
 
-    def _start(self, job: _Job) -> Handoff | None:
+    def _gather_pages(self, page_ids) -> list:
+        """Pull physical pages to host as the handoff payload (per-layer
+        dicts with a leading shipped-page axis — the decode pool's own
+        entry layout). A read: master/registry pages stay intact."""
+        idx = jnp.asarray(list(page_ids), jnp.int32)
+        return [
+            {key: np.asarray(arr[idx]) for key, arr in c.items()}
+            for c in self._pool
+        ]
+
+    def _paged_handoff(self, job: _Job, pages, n_full_prefix: int) -> Handoff:
+        """Assemble a paged handoff from a job's pages: with
+        ``ship_prefix_pages`` the matched prefix's FULL pages are elided
+        (the decode worker shares its own registration for those rows —
+        ``prefix_rows`` says how many); otherwise every page ships. The
+        straddling prefix page always ships — the suffix wrote into it."""
+        n_skip = n_full_prefix if self.ship_prefix_pages else 0
+        self.n_handoffs += 1
+        return Handoff(
+            frid=job.frid, prompt=job.prompt,
+            max_new_tokens=job.max_new_tokens,
+            prefill_len=len(job.prompt),
+            cache1=self._gather_pages(pages[n_skip:]),
+            logits=None,  # caller fills (registry hit vs fresh chunk)
+            submitted_at=job.submitted_at,
+            prefill_done_at=time.monotonic(),
+            key_rid=job.key_rid,
+            page_size=self.page_size,
+            prefix_rows=n_skip * self.page_size,
+        )
+
+    def _start(self, job: _Job):
         """Begin ``job``: an exact prefix hit completes immediately (COPIED
         master rows — the stored cache must survive for the next match);
         otherwise stage the pending chunk state (prefix rows copied in as
-        the starting cache when a partial hit applies)."""
+        the starting cache when a partial hit applies). Paged: reserve the
+        job's page plan first — returns the sentinel ``"wait"`` when the
+        pool cannot serve it yet (the job stays queued); an exact hit
+        ships straight from the registry pages, zero allocation."""
         pre = self._match_prefix(job.prompt) if self._prefixes else None
+        if self.paged:
+            from dsml_tpu.serving.paging import pages_for, plan_admission
+
+            L = len(job.prompt)
+            if pre is not None and len(pre[0]) == L:
+                ptoks, ppages, plogits = pre
+                n_full = (L // self.page_size if self.ship_prefix_pages
+                          else 0)
+                h = self._paged_handoff(job, list(ppages), n_full)
+                h.logits = np.asarray(plogits)
+                return h
+            p_len = len(pre[0]) if pre else 0
+            c = self.prefill_chunk
+            grid_end = p_len + -(-(L - p_len) // c) * c
+            plan = plan_admission(
+                self._pages, self.page_size, grid_end,
+                prefix_pages=pre[1] if pre else None, prefix_len=p_len,
+            )
+            if plan is None:
+                if self._pages.used_pages == self._registry_pages:
+                    # nothing in flight will ever free a page and the job
+                    # still can't reserve — a prefix registered AFTER this
+                    # submit shrank the ceiling past it (submit()'s
+                    # never-fits check guards the normal order)
+                    raise RuntimeError(
+                        f"prefill job {job.frid} can never reserve its "
+                        f"pages ({self._registry_pages} held by the prefix "
+                        "registry); register prefixes before accepting "
+                        "traffic, or raise n_pages"
+                    )
+                return "wait"  # pool full: the job keeps its queue spot
+            if plan.copy is not None:
+                src, dst = plan.copy
+                self._pool = self._copy_page(
+                    self._pool, jnp.int32(src), jnp.int32(dst)
+                )
+                self.n_cow_copies += 1
+            self._pending = (job, plan, p_len)  # suffix starts at the prefix
+            return None
         if pre is not None:
             ptoks, pcache, plogits = pre
             if len(ptoks) == len(job.prompt):
@@ -255,8 +410,12 @@ class PrefillWorker:
 
     def _advance(self) -> Handoff | None:
         """Run ONE chunk of the in-flight job; returns its handoff when
-        this chunk completed the prompt."""
-        job, cache1, start = self._pending
+        this chunk completed the prompt. Paged: the chunk writes straight
+        into the job's reserved pool pages; on completion the shipped
+        pages gather to host and EVERY page releases (shared prefix
+        references included — the allocator's refcounts keep the registry
+        masters alive)."""
+        job, state, start = self._pending
         c = self.prefill_chunk
         L = len(job.prompt)
         end = min(start + c, L)
@@ -265,10 +424,19 @@ class PrefillWorker:
         is_last = end >= L
         last_local = (L - 1) - start if is_last else c - 1
         t0 = time.monotonic()
-        logits, cache1 = self._chunk(
-            self.params, cache1, jnp.asarray(padded),
-            jnp.int32(start), jnp.int32(last_local),
-        )
+        if self.paged:
+            plan = state
+            table = np.zeros((1, self._n_pt), np.int32)
+            table[0, : len(plan.pages)] = plan.pages
+            logits, self._pool = self._chunk_paged(
+                self.params, self._pool, jnp.asarray(table),
+                jnp.asarray(padded), jnp.int32(start), jnp.int32(last_local),
+            )
+        else:
+            logits, state = self._chunk(
+                self.params, state, jnp.asarray(padded),
+                jnp.int32(start), jnp.int32(last_local),
+            )
         logits_host = np.asarray(logits[0])  # forces the dispatch to finish
         wall = time.monotonic() - t0
         self.n_chunk_dispatches += 1
@@ -283,14 +451,19 @@ class PrefillWorker:
             ).observe(wall * 1e3, replica=self.obs_replica,
                       role=self.obs_role)
         if not is_last:
-            self._pending = (job, cache1, start + c)
+            self._pending = (job, state, start + c)
             return None
         self._pending = None
+        if self.paged:
+            h = self._paged_handoff(job, plan.pages, plan.n_shared)
+            h.logits = logits_host
+            self._pages.release(plan.pages)
+            return h
         self.n_handoffs += 1
         return Handoff(
             frid=job.frid, prompt=job.prompt,
             max_new_tokens=job.max_new_tokens, prefill_len=L,
-            cache1=cache1, logits=logits_host,
+            cache1=state, logits=logits_host,
             submitted_at=job.submitted_at,
             prefill_done_at=time.monotonic(),
             key_rid=job.key_rid,
@@ -305,9 +478,13 @@ class PrefillWorker:
             if self._pending is None:
                 if not self._queue:
                     break
-                job = self._queue.popleft()
-                self._queued_tokens -= job.eff_tokens
+                job = self._queue[0]  # peek: a paged job that cannot
+                #                       reserve pages keeps its queue spot
                 h = self._start(job)
+                if h == "wait":
+                    break
+                self._queue.popleft()
+                self._queued_tokens -= job.eff_tokens
                 if h is not None:
                     out.append(h)  # exact prefix hit: no dispatch spent
                 continue
@@ -326,6 +503,11 @@ class PrefillWorker:
                 "prefilled requests handed to decode workers",
                 labels=("replica", "role"),
             ).inc(len(out), replica=self.obs_replica, role=self.obs_role)
+            if self.paged:
+                from dsml_tpu.serving.paging import export_pool_gauges
+
+                export_pool_gauges(self._obs, self._pages,
+                                   self.obs_replica, self.obs_role)
         return out
 
     def abandon(self) -> list[dict]:
@@ -338,6 +520,8 @@ class PrefillWorker:
         self._queued_tokens = 0
         if self._pending is not None:
             jobs.insert(0, self._pending[0])  # it has waited longest
+            if self.paged:  # the dead job's page reservation returns too
+                self._pages.release(self._pending[1].pages)
             self._pending = None
         return [
             {"frid": j.frid, "prompt": j.prompt,

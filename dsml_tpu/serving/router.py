@@ -87,6 +87,24 @@ class Router:
         for i, dw in enumerate(self.decode_workers):
             dw.obs_replica = str(i)
             dw.obs_role = "decode"
+        # paged fleets are all-or-nothing: a dense handoff cannot land in
+        # a page pool (and vice versa), so a mixed fleet is a deployment
+        # bug caught HERE, not inside a later tick's inject
+        paged = {bool(getattr(w, "paged", False))
+                 for w in self.prefill_workers + self.decode_workers}
+        if len(paged) > 1:
+            raise ValueError(
+                "mixed fleet: every prefill AND decode worker must agree "
+                "on paged_kv"
+            )
+        self.paged = paged.pop()
+        if self.paged:
+            shapes = {(w.page_size, w.page_quant)
+                      for w in self.prefill_workers + self.decode_workers}
+            if len(shapes) > 1:
+                raise ValueError(
+                    f"paged fleet disagrees on (page_size, quant): {shapes}"
+                )
         classes = list(slo_classes) if slo_classes else [SLOClass("default")]
         self._classes = {c.name: c for c in classes}
         if len(self._classes) != len(classes):
@@ -128,9 +146,25 @@ class Router:
     def register_prefix(self, tokens) -> None:
         """Replicate a shared prompt head across EVERY prefill worker (the
         fleet-wide system-prompt pattern): any worker the router picks
-        admits a matching prompt at O(L − P). Blocking setup call."""
+        admits a matching prompt at O(L − P). Blocking setup call.
+
+        On a PAGED fleet the registration also lands on every DECODE
+        worker (its page pool holds the prefix pages once, refcounted),
+        and prefill workers then ELIDE the prefix's full pages from
+        every matching handoff (``ship_prefix_pages``): the decode side
+        shares its local pages for those rows — the fleet-level CoW that
+        cuts both the handoff wire bytes and the decode-side HBM per
+        matching request."""
         for pw in self.prefill_workers:
             pw.register_prefix(tokens)
+        if self.paged:
+            for dw in self.decode_workers:
+                dw.register_prefix(tokens)
+            # every decode worker can now serve the shared rows locally —
+            # safe to stop shipping them (replication happens before any
+            # matching handoff exists: this is a blocking setup call)
+            for pw in self.prefill_workers:
+                pw.ship_prefix_pages = True
 
     def estimate_ttft_ms(self, prompt_len: int) -> float:
         """Measured-load TTFT estimate for a hypothetical new prompt:
@@ -247,27 +281,49 @@ class Router:
                 backlog.popleft()
                 self._prefill_at[frid] = pw
 
+    def decode_cost_s(self, dw) -> float:
+        """Per-token cost estimate for one decode worker — the TPOT cost
+        model the dispatch order uses. An acceptance-aware prediction
+        wins when the worker speculates and its EWMAs are warm
+        (``ContinuousBatcher.predicted_tpot_s``: measured verify-tick
+        wall over measured committed-tokens-per-tick — a worker whose
+        drafts stop landing gets expensive BEFORE harvested TPOT catches
+        up); otherwise the harvested per-worker TPOT EWMA."""
+        predict = getattr(dw, "predicted_tpot_s", None)
+        p = predict() if callable(predict) else None
+        if p is not None:
+            return p
+        return self._tpot_by_worker.get(id(dw), 0.0)
+
     def _route_handoff(self, h) -> bool:
         """Place one (already-transported) handoff on the decode worker
-        with the smallest (load, measured TPOT); returns False when every
-        worker is at its inject cap (the handoff waits in ``_ready``).
-        Caps are checked before injecting — the worker's own QueueFull
-        path counts a SHED, and a handoff that merely waits another tick
-        was never shed."""
+        with the smallest (load, TPOT cost estimate); returns False when
+        every worker is at its inject cap (the handoff waits in
+        ``_ready``). Caps are checked before injecting — the worker's own
+        QueueFull path counts a SHED, and a handoff that merely waits
+        another tick was never shed."""
         order = sorted(
             self.decode_workers,
             key=lambda w: (
                 w.n_active + w.n_queued + w.n_pending + w.n_injected,
-                self._tpot_by_worker.get(id(w), 0.0),
+                self.decode_cost_s(w),
             ),
         )
         for dw in order:
             if dw.max_queue and dw.n_injected >= dw.max_queue:
                 continue
-            lrid = dw.inject(
-                h.prompt, h.max_new_tokens, h.cache1, h.logits,
-                key_rid=h.key_rid, submitted_at=h.submitted_at,
-            )
+            if h.page_size is not None:
+                lrid = dw.inject(
+                    h.prompt, h.max_new_tokens, logits_row=h.logits,
+                    key_rid=h.key_rid, submitted_at=h.submitted_at,
+                    kv_pages=h.cache1, page_size=h.page_size,
+                    prefix_rows=h.prefix_rows,
+                )
+            else:
+                lrid = dw.inject(
+                    h.prompt, h.max_new_tokens, h.cache1, h.logits,
+                    key_rid=h.key_rid, submitted_at=h.submitted_at,
+                )
             self._local[(id(dw), lrid)] = h.frid
             self._decode_at[h.frid] = (dw, lrid)
             self._prefill_done_at[h.frid] = h.prefill_done_at
@@ -484,6 +540,9 @@ def build_fleet(
     transport=None,
     devices=None,
     prefill_max_queue: int = 0,
+    paged_kv=False,
+    page_size: int = 16,
+    prefill_n_pages: int = 0,
     **decode_kwargs,
 ) -> Router:
     """Assemble a disaggregated fleet: ``n_prefill`` chunked prefill
@@ -493,12 +552,24 @@ def build_fleet(
     workers run on the default device. ``decode_kwargs`` go to each
     decode batcher (``n_slots``, ``max_queue``, ``temperature``/``seed``,
     ...). Decode workers keep ``prefill_chunk=0`` — admission arrives
-    prefilled by construction."""
+    prefilled by construction. ``paged_kv`` builds a PAGED fleet (int4
+    page pools everywhere, paged handoffs, decode-side CoW prefixes —
+    docs/SERVING.md § Paged KV): ``page_size`` is fleet-wide,
+    ``prefill_n_pages`` sizes the prefill pools, and decode pool sizes
+    ride ``decode_kwargs['n_pages']``. Paged is single-device per decode
+    worker (exclusive with ``devices``)."""
+    if paged_kv and devices is not None:
+        raise ValueError("paged_kv decode workers are single-device; "
+                         "drop devices= or paged_kv=")
     prefill_workers = [
         PrefillWorker(model, params, prefill_chunk,
-                      max_queue=prefill_max_queue)
+                      max_queue=prefill_max_queue, paged_kv=paged_kv,
+                      page_size=page_size, n_pages=prefill_n_pages)
         for _ in range(n_prefill)
     ]
+    if paged_kv:
+        decode_kwargs.setdefault("paged_kv", paged_kv)
+        decode_kwargs.setdefault("page_size", page_size)
     if devices is not None:
         devices = list(devices)
         per = len(devices) // n_decode

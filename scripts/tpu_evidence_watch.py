@@ -46,6 +46,9 @@ SECTIONS = [
     #                          monolithic pool (virtual-8 CPU subprocess;
     #                          burst-isolation + throughput-parity verdicts
     #                          are the signal)
+    ("paged_kv", 900),  # paged int4 KV cache vs dense at equal HBM
+    #                     (virtual-8 CPU subprocess; capacity-ratio +
+    #                     bit-identity verdicts are the signal)
     ("gpt2_decode", 1200),  # plain + wq8 + kv8 + kv4 variants, 2 compiles each
     ("allreduce", 600),   # incl. the e2e wire-path row (VERDICT r3 item 7)
     ("gpt2_seq8k", 900),

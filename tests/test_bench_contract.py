@@ -264,6 +264,44 @@ def test_serving_fleet_section_schema(monkeypatch):
 
 
 @pytest.mark.slow
+def test_paged_kv_section_schema(monkeypatch):
+    """The BENCH `paged_kv` section's contract (ISSUE 11 acceptance): at
+    EQUAL analytic HBM budget the paged int4 pool holds ≥4× the dense
+    batcher's concurrent sequences (analytic accounting AND the measured
+    virtual-8 leg), greedy tokens are BIT-IDENTICAL to the dense batcher
+    running the same int4 codec, and the PR 10 burst schedule's p99
+    decode gap stays in the dense cache's band (the gather adds no tail
+    on this workload — 1.5× headroom for CPU wall noise; the real-chip
+    bar lives in the evidence capture). Runs the TINY A/B (the CI smoke
+    step's) — slow tier: the subprocess compiles several serving stacks."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    monkeypatch.setenv("DSML_PAGED_KV_TINY", "1")
+    rows = bench.bench_paged_kv()
+
+    assert "paged_kv_error" not in rows, rows
+    # analytic accounting is exact: budget = dense slots × dense bytes,
+    # and the int4 page rows are what buy the capacity ratio
+    assert rows["paged_kv_hbm_budget_bytes"] == (
+        rows["paged_kv_dense_slots"] * rows["paged_kv_dense_slot_bytes_f32"]
+    )
+    assert rows["paged_kv_capacity_ratio_analytic"] >= 4.0
+    # the measured leg: the paged pool actually held >=4x in flight
+    assert rows["paged_kv_measured_concurrency_ratio"] >= 4.0
+    assert rows["paged_kv_paged_peak_concurrent"] >= \
+        4 * rows["paged_kv_dense_peak_concurrent"]
+    # greedy tokens bit-identical to the dense int4 batcher
+    assert rows["paged_kv_greedy_bit_identical"] == 1
+    # burst p99 decode gap: no worse than dense (CPU-noise headroom)
+    assert rows["paged_kv_burst_gap_p99_ratio"] <= 1.5
+    # page-size sweep rows exist for the TUNING.md defaults
+    for ps in (8, 16):
+        assert rows[f"paged_kv_sweep_page{ps}_tick_p50_ms"] > 0
+        assert rows[f"paged_kv_sweep_page{ps}_capacity_tokens"] > 0
+
+
+@pytest.mark.slow
 def test_cpu_fallback_emits_under_hung_probe():
     """The capped-preflight path: probe hangs, preflight gives up inside its
     cap, and the CPU fallback still measures mnist and emits — the shape
