@@ -10,9 +10,12 @@ that roadmap TPU-first:
   row-sharded over the ``tp`` axis, ONE ``psum`` per attention block and one
   per MLP block; the unembedding is vocab-sharded with a
   distributed-logsumexp cross-entropy so full logits never materialize.
-- **SP/CP**: the sequence axis is sharded over ``sp``; attention runs as ring
-  attention (``ppermute`` K/V rotation) or Ulysses (all-to-all head
-  re-shard) — LoongTrain's 2D head×context grid is exactly ``tp × sp`` here.
+- **SP/CP**: the sequence axis is sharded over ``sp`` (legacy XLA ring /
+  Ulysses) or the ``cp`` context-parallel axis (``attn_impl="ring2"``: the
+  bidirectional flash ring with causal hop skipping and a KV re-streaming
+  backward, ``ops.ring_attention``) — the model is axis-name-generic, the
+  hybrid step passes whichever axis the mesh sizes; LoongTrain's 2D
+  head×context grid is exactly ``tp × sp`` here.
 - **DP**: batch axis sharded over ``dp``; gradients ``psum`` over (dp, sp).
 - **EP (MoE)**: optionally the MLP is a top-k-gated expert layer with experts
   sharded over ``tp`` and token dispatch via ``all_to_all``.
@@ -455,18 +458,35 @@ class GPT2:
             ffn = jax.checkpoint(ffn)
         return h + ffn(layer[key], layer["ln_2"], h)
 
-    _ATTN_IMPLS = ("ring", "ulysses", "ulysses_flash", "ring_flash", "flash", "xla")
+    _ATTN_IMPLS = ("ring", "ring2", "ulysses", "ulysses_flash", "ring_flash", "flash", "xla")
 
     def _route_attention(self, q, k, v, sp_axis, attn_impl):
         """[b, h_local, s, hd] q/k/v → causal attention output, routed to the
-        impl that is CORRECT for the sharding (shared by GPT-2 and Llama)."""
+        impl that is CORRECT for the sharding (shared by GPT-2 and Llama).
+
+        ``sp_axis`` is whichever mesh axis the SEQUENCE is sharded over —
+        the legacy ``sp`` ring or the ``cp`` context-parallel axis
+        (``parallel.hybrid`` passes the resolved name; the impls are
+        axis-name-generic). ``"ring2"`` is the cp tentpole: bidirectional
+        flash ring with causal hop skipping and the KV re-streaming backward
+        (``ops.ring_attention``) — the training default on cp meshes."""
         if attn_impl not in self._ATTN_IMPLS:
             # a typo would otherwise silently train on the ring/XLA fallback
             raise ValueError(f"unknown attn_impl {attn_impl!r}; choose from {self._ATTN_IMPLS}")
+        if sp_axis and lax.axis_size(sp_axis) == 1:
+            # a size-1 sequence ring means the sequence is NOT sharded: route
+            # as single-chip so "flash" actually runs the Pallas kernel (the
+            # truthy-name check used to send it through the n=1 XLA ring →
+            # dense attention — silently benching the wrong implementation)
+            sp_axis = None
         if sp_axis:
             # sequence is sharded: only ring/Ulysses see the full context.
             # Anything else (incl. "flash", a single-chip kernel) would be
             # silently-wrong block-diagonal attention — route it to ring.
+            if attn_impl == "ring2":
+                from dsml_tpu.ops.ring_attention import ring_attention as ring2_attention
+
+                return ring2_attention(q, k, v, sp_axis, causal=True)
             if attn_impl == "ulysses":
                 return ulysses_attention(q, k, v, sp_axis, causal=True)
             if attn_impl == "ulysses_flash":
@@ -476,7 +496,7 @@ class GPT2:
 
                 return ring_flash_attention(q, k, v, sp_axis, causal=True)
             return ring_attention(q, k, v, sp_axis, causal=True)
-        if attn_impl in ("flash", "ring_flash", "ulysses_flash"):
+        if attn_impl in ("flash", "ring_flash", "ulysses_flash", "ring2"):
             # no sp axis → every flash variant degenerates to the
             # single-chip kernel (falling through to plain attention would
             # materialize the [seq, seq] scores the caller chose flash to
@@ -1137,7 +1157,7 @@ class GPT2:
         cache = self.init_cache(b, tp_size)
         # long prompts: the plain path materializes [T, T] scores per head —
         # route through the flash kernel so prefill memory stays O(block²)
-        # (flash_attention itself falls back for untileable lengths)
+        # (untileable lengths ride the kernel's padded kv_stop path)
         use_flash = self._prefill_use_flash(t)
         if use_flash:
             from dsml_tpu.ops.flash import flash_attention

@@ -10,8 +10,10 @@ pipelines, FSDP, elastic reconfigure) is MODEL-GENERIC. Llama subclasses
 
 - **RMSNorm** instead of LayerNorm (no mean-centering, no bias).
 - **RoPE** rotary position embeddings applied to q/k inside attention — no
-  learned position table; under sequence parallelism each sp rank rotates by
-  its GLOBAL positions (rank · s_local offset), so ring/Ulysses attention
+  learned position table; under sequence parallelism each sp/cp rank rotates
+  by its GLOBAL positions (rank · s_local offset), so ring/Ulysses attention
+  — including the context-parallel flash ring, ``attn_impl="ring2"``
+  (``ops.ring_attention``; parity pinned in tests/test_ring_attention.py) —
   stays exact.
 - **SwiGLU** MLP: ``silu(x·w_gate) ⊙ (x·w_up) · w_down`` — gate/up
   column-sharded, down row-sharded (same Megatron psum points as GPT-2).

@@ -182,12 +182,21 @@ _NOT_A_METRIC = (
     # acceptance/window telemetry is workload-dependent
     "pages_at_budget", "page_size", "bit_identical", "_peak_concurrent",
     "capacity_tokens", "windows_used", "accept_rate", "ticks_per_token",
+    # long_context section: ladder geometry + analytic accounting rows.
+    # The KV wire-byte rows are EXACT schedule counts (the generic "_bytes"
+    # rule above already exempts them — a changed count is a schedule
+    # change the contract test pins, not a noise-band question) and the
+    # _act_gb headroom table is analytic; rung `_ms` cells stay gated
+    # down-good via the `_ms` suffix rule, `_mfu`/`max_tokens` up-good.
+    "rungs_planned", "ladder_target", "keep_fraction", "_act_gb",
 )
 _HIGHER_BETTER = (
     "samples_per_sec", "tokens_per_sec", "tokens_per_s", "goodput",
     "accuracy", "mfu", "speedup", "coverage_pct",
     # paged_kv: concurrent-sequence capacity per HBM byte — the headline
     "capacity_ratio", "concurrency_ratio",
+    # long_context: the highest sequence rung a train step COMPLETED
+    "max_tokens",
 )
 _LOWER_BETTER_SUFFIX = ("_ms", "_s", "_sec", "_pct", "_ppl")
 # "ttft"/"tpot": the serving_fleet section's time-to-first-token and

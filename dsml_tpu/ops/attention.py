@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dsml_tpu.ops.collectives import ring_pass
+
 __all__ = ["attention", "ring_attention", "ulysses_attention", "attention_2d"]
 
 _NEG_INF = -1e30
@@ -74,7 +76,6 @@ def ring_attention(
     rank = lax.axis_index(axis_name)
     seq_block = q.shape[-2]
     scale = q.shape[-1] ** -0.5
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
     def fold(carry, kv_block, k_offset):
         num, den, row_max = carry
@@ -103,7 +104,7 @@ def ring_attention(
         k_offset = (rank - hop) % n  # whose K/V block is resident this hop
         carry = fold(carry, kv, k_offset)
         if hop != n - 1:
-            kv = jax.tree.map(lambda t: lax.ppermute(t, axis_name, perm), kv)
+            kv = ring_pass(kv, axis_name, +1)
     num, den, _ = carry
     return num / jnp.maximum(den, 1e-30)
 

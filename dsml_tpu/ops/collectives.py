@@ -41,6 +41,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "ReduceOp",
+    "ring_perm_tables",
+    "ring_pass",
     "ring_all_reduce",
     "ring2_all_reduce",
     "naive_all_reduce",
@@ -87,10 +89,29 @@ def _axis_size(axis_name: str) -> int:
     return lax.axis_size(axis_name)
 
 
-def _ring_perm(n: int) -> list[tuple[int, int]]:
-    """rank i sends to rank (i+1) % n — the ring the reference's neighbor
-    computation encodes (gpu_coordinator_server.go:407-419)."""
-    return [(i, (i + 1) % n) for i in range(n)]
+def ring_perm_tables(n: int) -> dict[int, list[tuple[int, int]]]:
+    """Explicit ppermute perm tables for BOTH ring directions: ``+1`` sends
+    rank i → i+1 (the reference's forward schedule), ``-1`` the mirror.
+    THE one definition of the ring neighborhood — the fp32 ring
+    (:func:`ring_all_reduce`/``ring2``), the quantized ring
+    (``ops.quantization.quantized_ring_all_reduce``), and ring attention
+    (``ops.ring_attention``) all rotate through these tables, so the three
+    ring schedules cannot drift apart."""
+    return {
+        +1: [(i, (i + 1) % n) for i in range(n)],
+        -1: [(i, (i - 1) % n) for i in range(n)],
+    }
+
+
+def ring_pass(x, axis_name: str, sign: int = +1):
+    """One rotate step of the ring schedule: every leaf of ``x`` hops to the
+    ``sign``-direction neighbor (``+1`` = rank i → i+1, ``-1`` = the
+    mirror). Accepts a pytree (K/V pairs, (wire, scales) tuples) so callers
+    rotate their whole hop state in one call. Must run under ``shard_map``."""
+    if sign not in (+1, -1):
+        raise ValueError(f"ring_pass sign must be +1 or -1, got {sign!r}")
+    perm = ring_perm_tables(_axis_size(axis_name))[sign]
+    return jax.tree.map(lambda t: lax.ppermute(t, axis_name, perm), x)
 
 
 # ---------------------------------------------------------------------------
@@ -135,11 +156,10 @@ def _ring_all_reduce_impl(x: jax.Array, axis_name: str, op: ReduceOp, signs: tup
     bufs = [flat[i * part : (i + 1) * part].reshape(n, seg) for i in range(k)]
 
     rank = lax.axis_index(axis_name)
-    perms = {+1: _ring_perm(n), -1: [(i, (i - 1) % n) for i in range(n)]}
 
     def hop(buf, sign, send_idx, recv_idx, combine):
         chunk = lax.dynamic_index_in_dim(buf, send_idx, axis=0, keepdims=False)
-        recv = lax.ppermute(chunk, axis_name, perms[sign])
+        recv = ring_pass(chunk, axis_name, sign)
         resident = lax.dynamic_index_in_dim(buf, recv_idx, 0, keepdims=False)
         new = combine(resident, recv) if combine is not None else recv
         return lax.dynamic_update_index_in_dim(buf, new, recv_idx, axis=0)

@@ -21,7 +21,19 @@ touches HBM:
   offsets (SMEM scalars, so the causal mask is correct for any hop pair),
   K/V rotate via ``ppermute``, and the per-hop (out, lse) pairs merge with
   logsumexp weights. Exact full attention at O(block²) VMEM per chip —
-  Ring Self-Attention (SURVEY.md §5.7) with a flash inner loop.
+  Ring Self-Attention (SURVEY.md §5.7) with a flash inner loop. For cp
+  TRAINING prefer ``ops.ring_attention`` (``attn_impl="ring2"``): same
+  merge math plus bidirectional streaming, causal hop skipping, and a
+  backward that re-streams KV instead of letting autodiff save every
+  visiting block (this one's residuals grow O(S) with ring size).
+- :func:`flash_block_grads` — the raw one-block backward given MERGED
+  (out, lse) statistics; the primitive that re-streaming backward calls.
+
+Sequences that don't tile into blocks run through a PADDED path: zero-pad
+to a block multiple (≤ 25% waste), mask the padded kv tail inside the
+kernels via a ``kv_stop`` SMEM scalar, slice padded q rows off outputs —
+cp/ring shards make odd residual lengths the common case.
+``DSML_FLASH_BLOCK`` overrides the swept block defaults (docs/TUNING.md).
 
 Causal blocks entirely above the diagonal are skipped via ``pl.when``
 predication (a dynamic predicate when offsets are traced). On non-TPU
@@ -36,18 +48,26 @@ Used by ``dsml_tpu.models.gpt2`` via ``attn_impl="flash"`` (single-chip) and
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from dsml_tpu.ops.collectives import ring_pass
+
 try:  # pltpu is importable on CPU builds too; guard anyway
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
     pltpu = None
 
-__all__ = ["flash_attention", "flash_attention_lse", "ring_flash_attention"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_lse",
+    "flash_block_grads",
+    "ring_flash_attention",
+]
 
 _NEG_INF = -1e30
 _MAX_FLOOR = -1e20  # running-max floor: keeps exp() sane for fully-masked rows
@@ -85,6 +105,48 @@ def _pick_block(seq: int, preferred: int) -> int | None:
     return None
 
 
+def _pad_choice(seq: int, preferred: int) -> tuple[int, int]:
+    """(block, padded_len): exact ladder tiling when ``seq`` divides a ladder
+    block (today's path, byte-identical); otherwise the largest ladder block
+    whose zero-padding waste stays ≤ 25% of the padded length (floor 8).
+    Ring/cp shards make odd residual lengths the COMMON case, and a
+    sub-block pad — masked off via the kernels' kv_stop scalar — beats
+    falling off the kernel onto the O(s²) XLA path."""
+    b = _pick_block(seq, preferred)
+    if b is not None:
+        return b, seq
+    for cand in (preferred, 512, 256, 128, 64, 32, 16, 8):
+        if cand > preferred:
+            continue
+        padded = -(-seq // cand) * cand
+        if (padded - seq) * 4 <= padded:
+            return cand, padded
+    return 8, -(-seq // 8) * 8
+
+
+def _env_block_override() -> tuple[int | None, int | None]:
+    """``DSML_FLASH_BLOCK`` override for the auto block defaults: ``"B"``
+    (both blocks) or ``"BQxBK"``. Lets cp-sharded (shorter per-rank)
+    sequences be tuned without editing the kernel; explicit ``block_q``/
+    ``block_k`` arguments still win. Malformed or non-multiple-of-8 values
+    are ignored — a bad env var must degrade to the swept defaults, never
+    crash a trace (docs/TUNING.md)."""
+    raw = os.environ.get("DSML_FLASH_BLOCK", "").strip().lower()
+    if not raw:
+        return None, None
+    try:
+        if "x" in raw:
+            q_s, k_s = raw.split("x", 1)
+            bq, bk = int(q_s), int(k_s)
+        else:
+            bq = bk = int(raw)
+    except ValueError:
+        return None, None
+    if bq < 8 or bk < 8 or bq % 8 or bk % 8:
+        return None, None
+    return bq, bk
+
+
 def _default_blocks(
     s_q: int, s_kv: int, block_q: int | None, block_k: int | None,
     head_dim: int | None = None,
@@ -102,7 +164,16 @@ def _default_blocks(
     Below 4096 the 512x512 tiling measured best-or-equal wherever the
     differenced signal rose above tunnel jitter. Callers can still pin
     blocks explicitly (the ring path does, per-shard); lengths the
-    preferred block doesn't divide degrade through _pick_block's ladder."""
+    preferred block doesn't divide degrade through _pick_block's ladder.
+
+    ``DSML_FLASH_BLOCK`` ("B" or "BQxBK") overrides the swept auto defaults
+    — the tuning knob for cp-sharded per-rank lengths the sweep never saw —
+    but explicit arguments always win over the env."""
+    env_q, env_k = _env_block_override()
+    if block_q is None:
+        block_q = env_q
+    if block_k is None:
+        block_k = env_k
     widen = head_dim is not None and head_dim <= 64
     if block_q is None:
         block_q = 1024 if (s_q >= 4096 and widen) else 512
@@ -123,7 +194,7 @@ def _positions(qs, ks, qi, ki, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale, causal, block_q, block_k, kv_blocks):
+def _fwd_kernel(qs_ref, ks_ref, kstop_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale, causal, block_q, block_k, kv_blocks, mask_kv):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     qs, ks = qs_ref[0], ks_ref[0]
@@ -141,9 +212,14 @@ def _fwd_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if causal:
+        if mask_kv or causal:
             q_pos, k_pos = _positions(qs, ks, qi, ki, block_q, block_k)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            if mask_kv:
+                # zero-padded kv tail (sequence not a block multiple): its
+                # columns must not enter the softmax denominator
+                s = jnp.where(k_pos < kstop_ref[0], s, _NEG_INF)
+            if causal:
+                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         corr = jnp.exp(m_prev - m_new)
@@ -172,7 +248,7 @@ def _fwd_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr,
         lse_ref[0] = jnp.broadcast_to((m_scr[:, :1] + jnp.log(l_fin)).reshape(1, block_q), (8, block_q))
 
 
-def _flash_fwd(q, k, v, q_start, k_start, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, q_start, k_start, kv_stop, causal, block_q, block_k, interpret, mask_kv):
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
     scale = d**-0.5
@@ -180,12 +256,13 @@ def _flash_fwd(q, k, v, q_start, k_start, causal, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, kv_blocks=kv_blocks,
+        block_q=block_q, block_k=block_k, kv_blocks=kv_blocks, mask_kv=mask_kv,
     )
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, q_blocks, kv_blocks),
         in_specs=[
+            _smem_spec(),
             _smem_spec(),
             _smem_spec(),
             _vmem_spec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
@@ -206,7 +283,7 @@ def _flash_fwd(q, k, v, q_start, k_start, causal, block_q, block_k, interpret):
             _scratch((block_q, 128)),
         ],
         interpret=interpret,
-    )(_scalar(q_start), _scalar(k_start), q, k, v)
+    )(_scalar(q_start), _scalar(k_start), _scalar(kv_stop), q, k, v)
     return out, lse
 
 
@@ -219,7 +296,7 @@ def _scalar(x):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref, dq_ref, acc, *, scale, causal, block_q, block_k, kv_blocks):
+def _dq_kernel(qs_ref, ks_ref, kstop_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref, dq_ref, acc, *, scale, causal, block_q, block_k, kv_blocks, mask_kv):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     qs, ks = qs_ref[0], ks_ref[0]
@@ -239,9 +316,12 @@ def _dq_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if causal:
+        if mask_kv or causal:
             q_pos, k_pos = _positions(qs, ks, qi, ki, block_q, block_k)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            if mask_kv:
+                s = jnp.where(k_pos < kstop_ref[0], s, _NEG_INF)
+            if causal:
+                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta + glse)  # glse: cotangent of the lse output
@@ -261,7 +341,7 @@ def _dq_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, 
         dq_ref[0] = (acc[:] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, block_q, block_k, q_blocks):
+def _dkv_kernel(qs_ref, ks_ref, kstop_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, block_q, block_k, q_blocks, mask_kv):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     qs, ks = qs_ref[0], ks_ref[0]
@@ -282,9 +362,12 @@ def _dkv_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if causal:
+        if mask_kv or causal:
             q_pos, k_pos = _positions(qs, ks, qi, ki, block_q, block_k)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            if mask_kv:
+                s = jnp.where(k_pos < kstop_ref[0], s, _NEG_INF)
+            if causal:
+                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse)  # [bq, bk]
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -309,7 +392,7 @@ def _dkv_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse8, do, glse8, q_start, k_start, causal, block_q, block_k, interpret):
+def _flash_bwd(q, k, v, o, lse8, do, glse8, q_start, k_start, kv_stop, causal, block_q, block_k, interpret, mask_kv):
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
     scale = d**-0.5
@@ -317,6 +400,7 @@ def _flash_bwd(q, k, v, o, lse8, do, glse8, q_start, k_start, causal, block_q, b
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [bh, s_q]
     delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, s_q))  # sublane-aligned like lse
     qrow = [
+        _smem_spec(),
         _smem_spec(),
         _smem_spec(),
         _vmem_spec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
@@ -331,7 +415,7 @@ def _flash_bwd(q, k, v, o, lse8, do, glse8, q_start, k_start, causal, block_q, b
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, kv_blocks=kv_blocks,
+            block_q=block_q, block_k=block_k, kv_blocks=kv_blocks, mask_kv=mask_kv,
         ),
         grid=(bh, q_blocks, kv_blocks),
         in_specs=qrow,
@@ -339,9 +423,10 @@ def _flash_bwd(q, k, v, o, lse8, do, glse8, q_start, k_start, causal, block_q, b
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[_scratch((block_q, d))],
         interpret=interpret,
-    )(_scalar(q_start), _scalar(k_start), q, k, v, do, lse8, delta, glse8)
+    )(_scalar(q_start), _scalar(k_start), _scalar(kv_stop), q, k, v, do, lse8, delta, glse8)
 
     krow = [
+        _smem_spec(),
         _smem_spec(),
         _smem_spec(),
         _vmem_spec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
@@ -355,7 +440,7 @@ def _flash_bwd(q, k, v, o, lse8, do, glse8, q_start, k_start, causal, block_q, b
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, q_blocks=q_blocks,
+            block_q=block_q, block_k=block_k, q_blocks=q_blocks, mask_kv=mask_kv,
         ),
         grid=(bh, kv_blocks, q_blocks),
         in_specs=krow,
@@ -369,7 +454,7 @@ def _flash_bwd(q, k, v, o, lse8, do, glse8, q_start, k_start, causal, block_q, b
         ],
         scratch_shapes=[_scratch((block_k, d)), _scratch((block_k, d))],
         interpret=interpret,
-    )(_scalar(q_start), _scalar(k_start), q, k, v, do, lse8, delta, glse8)
+    )(_scalar(q_start), _scalar(k_start), _scalar(kv_stop), q, k, v, do, lse8, delta, glse8)
     return dq, dk, dv
 
 
@@ -378,26 +463,27 @@ def _flash_bwd(q, k, v, o, lse8, do, glse8, q_start, k_start, causal, block_q, b
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, q_start, k_start, causal, block_q, block_k, interpret):
-    out, lse8 = _flash_fwd(q, k, v, q_start, k_start, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash(q, k, v, q_start, k_start, kv_stop, causal, block_q, block_k, interpret, mask_kv):
+    out, lse8 = _flash_fwd(q, k, v, q_start, k_start, kv_stop, causal, block_q, block_k, interpret, mask_kv)
     return out, lse8[:, 0, :]
 
 
-def _flash_fwd_rule(q, k, v, q_start, k_start, causal, block_q, block_k, interpret):
-    out, lse8 = _flash_fwd(q, k, v, q_start, k_start, causal, block_q, block_k, interpret)
-    return (out, lse8[:, 0, :]), (q, k, v, out, lse8, q_start, k_start)
+def _flash_fwd_rule(q, k, v, q_start, k_start, kv_stop, causal, block_q, block_k, interpret, mask_kv):
+    out, lse8 = _flash_fwd(q, k, v, q_start, k_start, kv_stop, causal, block_q, block_k, interpret, mask_kv)
+    return (out, lse8[:, 0, :]), (q, k, v, out, lse8, q_start, k_start, kv_stop)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse8, q_start, k_start = res
+def _flash_bwd_rule(causal, block_q, block_k, interpret, mask_kv, res, g):
+    q, k, v, out, lse8, q_start, k_start, kv_stop = res
     g_out, g_lse = g
     bh, s_q, _ = q.shape
     glse8 = jnp.broadcast_to(g_lse.astype(jnp.float32)[:, None, :], (bh, 8, s_q))
     dq, dk, dv = _flash_bwd(
-        q, k, v, out, lse8, g_out, glse8, q_start, k_start, causal, block_q, block_k, interpret
+        q, k, v, out, lse8, g_out, glse8, q_start, k_start, kv_stop, causal,
+        block_q, block_k, interpret, mask_kv
     )
-    return dq, dk, dv, None, None
+    return dq, dk, dv, None, None, None
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -431,18 +517,35 @@ def flash_attention_lse(
     ``q_start``/``k_start`` are the GLOBAL positions of the first q/k row
     (traced values allowed) — the causal mask compares global positions, so
     ring/sharded callers can run any (q-block, kv-block) pair. Both outputs
-    are differentiable; requires the sequence to tile into blocks.
+    are differentiable. ANY length runs through the kernel: lengths the
+    block ladder can't tile exactly are zero-padded up to a block multiple
+    (≤ 25% waste), with the padded kv tail masked off inside the kernels via
+    a ``kv_stop`` SMEM scalar and padded q rows sliced away — cp/ring shards
+    make odd residual lengths the common case, so the kernel rather than an
+    XLA fallback must own them.
     """
     b, h, s_q, d = q.shape
     s_kv = k.shape[2]
     block_q, block_k = _default_blocks(s_q, s_kv, block_q, block_k, d)
-    bq = _pick_block(s_q, block_q)
-    bk = _pick_block(s_kv, block_k)
-    if bq is None or bk is None:
-        raise ValueError(f"sequence ({s_q}, {s_kv}) does not tile into flash blocks")
+    bq, pq = _pad_choice(s_q, block_q)
+    bk, pk = _pad_choice(s_kv, block_k)
     if interpret is None:
         interpret = _interpret_default()
-    out, lse = _flash(_flat3(q), _flat3(k), _flat3(v), q_start, k_start, causal, bq, bk, interpret)
+    mask_kv = pk != s_kv
+    qf, kf, vf = _flat3(q), _flat3(k), _flat3(v)
+    if pq != s_q:
+        # padded q rows are ZERO (s = 0·k exactly — no overflow risk in the
+        # backward's p = exp(s − lse)) and sliced off below; the slice's
+        # transpose zero-pads their cotangent, so autodiff needs no help
+        qf = jnp.pad(qf, ((0, 0), (0, pq - s_q), (0, 0)))
+    if mask_kv:
+        kf = jnp.pad(kf, ((0, 0), (0, pk - s_kv), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, pk - s_kv), (0, 0)))
+    kv_stop = k_start + s_kv  # global position the REAL kv columns end at
+    out, lse = _flash(qf, kf, vf, q_start, k_start, kv_stop, causal, bq, bk, interpret, mask_kv)
+    if pq != s_q:
+        out = out[:, :s_q]
+        lse = lse[:, :s_q]
     return out.reshape(b, h, s_q, d), lse.reshape(b, h, s_q)
 
 
@@ -460,19 +563,85 @@ def flash_attention(
     Numerically equivalent to ``dsml_tpu.ops.attention.attention`` (tests
     assert it) but never materializes the [seq, seq] score matrix — peak
     memory is O(block_q · block_k) per core instead of O(seq²) per head.
-    Falls back to the plain fused-XLA path when the sequence doesn't tile
-    (block sizes must divide seq_q/seq_kv).
+    Sequences that don't tile into blocks run through the kernel's padded
+    path (zero-padded to a block multiple, kv tail masked via ``kv_stop``)
+    rather than falling back to the O(s²) XLA graph.
     """
     if q.ndim != 4:
         raise ValueError(f"expected [batch, heads, seq, head_dim], got {q.shape}")
-    block_q, block_k = _default_blocks(q.shape[2], k.shape[2], block_q, block_k,
-                                       q.shape[3])
-    if _pick_block(q.shape[2], block_q) is None or _pick_block(k.shape[2], block_k) is None:
-        from dsml_tpu.ops.attention import attention
-
-        return attention(q, k, v, causal)
     out, _ = flash_attention_lse(q, k, v, causal, 0, 0, block_q, block_k, interpret)
     return out
+
+
+def flash_block_grads(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    out: jax.Array,
+    lse: jax.Array,
+    do: jax.Array,
+    g_lse: jax.Array | None = None,
+    causal: bool = True,
+    q_start: jax.Array | int = 0,
+    k_start: jax.Array | int = 0,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Raw flash backward of ONE (q-shard, kv-block) pair given MERGED
+    statistics — the primitive ring attention's own backward re-streams KV
+    through (``ops.ring_attention``).
+
+    ``out``/``lse`` are the TOTAL attention output and logsumexp over EVERY
+    kv block (the ring's merged accumulators), so the kernels' recomputed
+    ``p = exp(s − lse)`` are the globally-correct softmax rows and the
+    returned ``(dq, dk, dv)`` are this block pair's exact contributions to
+    the full-attention gradients — summing them over all kv blocks
+    reproduces the single-call flash backward. No custom-vjp wrapper: the
+    caller owns the accumulation (dq locally, dk/dv around the reverse
+    ring). Handles untileable lengths through the same padded path as
+    :func:`flash_attention_lse`.
+
+    Shapes: q/out/do [b, h, s_q, hd], k/v [b, h, s_kv, hd], lse/g_lse
+    [b, h, s_q] (``g_lse``: cotangent of the merged lse output, None = 0).
+    Returns float32 (dq, dk, dv) with the unpadded input shapes.
+    """
+    b, h, s_q, d = q.shape
+    s_kv = k.shape[2]
+    block_q, block_k = _default_blocks(s_q, s_kv, block_q, block_k, d)
+    bq, pq = _pad_choice(s_q, block_q)
+    bk, pk = _pad_choice(s_kv, block_k)
+    if interpret is None:
+        interpret = _interpret_default()
+    mask_kv = pk != s_kv
+    qf, of, dof = _flat3(q), _flat3(out), _flat3(do)
+    kf, vf = _flat3(k), _flat3(v)
+    lse_f = lse.reshape(b * h, s_q).astype(jnp.float32)
+    glse_f = (
+        jnp.zeros_like(lse_f) if g_lse is None
+        else g_lse.reshape(b * h, s_q).astype(jnp.float32)
+    )
+    if pq != s_q:
+        pad3 = ((0, 0), (0, pq - s_q), (0, 0))
+        qf, of, dof = (jnp.pad(t, pad3) for t in (qf, of, dof))
+        # padded q rows: q = 0 ⇒ s = 0 exactly and do = 0 ⇒ ds = 0, so a
+        # zero-padded lse (p = exp(0 − 0) = 1) contributes nothing anywhere
+        # a real gradient lands; their dq rows are sliced off below
+        lse_f = jnp.pad(lse_f, ((0, 0), (0, pq - s_q)))
+        glse_f = jnp.pad(glse_f, ((0, 0), (0, pq - s_q)))
+    if mask_kv:
+        pad3 = ((0, 0), (0, pk - s_kv), (0, 0))
+        kf, vf = jnp.pad(kf, pad3), jnp.pad(vf, pad3)
+    lse8 = jnp.broadcast_to(lse_f[:, None, :], (b * h, 8, pq))
+    glse8 = jnp.broadcast_to(glse_f[:, None, :], (b * h, 8, pq))
+    dq, dk, dv = _flash_bwd(
+        qf, kf, vf, of, lse8, dof, glse8, q_start, k_start, k_start + s_kv,
+        causal, bq, bk, interpret, mask_kv,
+    )
+    dq = dq[:, :s_q].astype(jnp.float32).reshape(b, h, s_q, d)
+    dk = dk[:, :s_kv].astype(jnp.float32).reshape(b, h, s_kv, d)
+    dv = dv[:, :s_kv].astype(jnp.float32).reshape(b, h, s_kv, d)
+    return dq, dk, dv
 
 
 def ring_flash_attention(
@@ -513,7 +682,6 @@ def ring_flash_attention(
 
         return ring_attention(q, k, v, axis_name, causal)
     rank = lax.axis_index(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
     # Online merge (same shape as ops.attention.ring_attention's fold): only
     # ONE running (out, lse) pair is alive — stacking all n hops would hold
@@ -538,6 +706,6 @@ def ring_flash_attention(
             run_out = w_prev * run_out + w_new * o
             run_lse = new_lse
         if hop != n - 1:
-            kv = jax.tree.map(lambda t: lax.ppermute(t, axis_name, perm), kv)
+            kv = ring_pass(kv, axis_name, +1)
 
     return run_out.astype(q.dtype)

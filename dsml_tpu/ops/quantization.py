@@ -435,10 +435,10 @@ def compressed_gather_wire_bytes(n_elems: int, n_ranks: int) -> int:
 
 
 def _ring_perms(n: int) -> dict:
-    return {
-        +1: [(i, (i + 1) % n) for i in range(n)],
-        -1: [(i, (i - 1) % n) for i in range(n)],
-    }
+    # one definition of the ring neighborhood for every ring schedule
+    from dsml_tpu.ops.collectives import ring_perm_tables
+
+    return ring_perm_tables(n)
 
 
 def _dither_seed(blocks: jax.Array, base, rank, salt: int) -> jax.Array:

@@ -1,0 +1,286 @@
+"""Context-parallel ring attention over the Pallas flash kernel — the
+sequence axis sharded across the ``cp`` mesh axis, KV blocks STREAMED around
+the ring instead of any chip ever holding full-length attention.
+
+This is the sequence-scaling tentpole the length ladder pointed at: a single
+chip with selective remat tops out ~32k tokens; here each of the ``cp`` ranks
+holds its S/cp slice of Q/K/V and the mesh, not the chip, holds the context.
+Three properties distinguish it from the simpler ``ops.flash.
+ring_flash_attention`` (which it supersedes for training):
+
+- **Bidirectional ring2 schedule** — the per-rank KV shard splits into two
+  halves that rotate in OPPOSITE directions via
+  ``ops.collectives.ring_pass`` (the same ±1 perm tables the fp32 and
+  quantized ring all-reduces rotate through). TPU ICI links are full
+  duplex, so each direction carries HALF the KV volume on otherwise-idle
+  reverse capacity — the ring2 trick, applied to attention's KV stream.
+- **Causal hop skipping** — a visiting KV block whose source rank is
+  strictly later in the sequence is fully masked for every resident query;
+  the flash call is skipped via ``lax.cond`` (rank-dynamic: each device
+  evaluates its own predicate at runtime), so late hops don't burn MXU time
+  computing an all-−inf score block. Compute retained is (n+1)/2n of the
+  full grid — asymptotically the causal 2× (see
+  :func:`causal_keep_fraction`).
+- **KV re-streaming backward** — the ring-LEVEL ``custom_vjp`` saves only
+  this rank's residents (q, k, v, out, lse): O(S/cp) residuals. Plain
+  autodiff through the forward loop would instead save every VISITING kv
+  pair — n shards = the full sequence per chip, silently defeating the
+  memory point of sequence parallelism. The backward re-streams K/V around
+  the ring a second time, recomputing each hop's block gradients from the
+  merged (out, lse) statistics (``ops.flash.flash_block_grads`` — flash
+  residuals stay resident), accumulating dq locally while dk/dv ride the
+  ring WITH their blocks and take one final hop home to their owners.
+
+Per-hop (out, lse) pairs merge with logsumexp weights —
+
+    lse_tot = logsumexp_i(lse_i);  out = Σᵢ exp(lse_i − lse_tot) · out_i
+
+— which reconstructs exact full attention; forward AND backward parity to
+the single-device flash kernel is pinned in ``tests/test_ring_attention.py``
+at cp ∈ {2, 4}, causal and not, odd lengths included (the padded flash path
+owns residual blocks). Wire volume is exactly counted, never sampled:
+:func:`ring_kv_wire_bytes`.
+
+Used by the model families as ``attn_impl="ring2"`` on meshes with cp > 1
+(``parallel.hybrid`` composes cp with dp/fsdp; per-rank positions are offset
+by the shard origin exactly as for the legacy sp ring).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dsml_tpu.ops.collectives import ring_pass
+from dsml_tpu.ops.flash import flash_attention, flash_attention_lse, flash_block_grads
+
+__all__ = ["ring_attention", "ring_kv_wire_bytes", "causal_keep_fraction"]
+
+_LSE_FLOOR = -1e30  # "nothing seen": logaddexp identity, exp(floor − x) = 0
+
+
+def _halves(s_local: int) -> list[tuple[int, int, int]]:
+    """(row_start, row_len, ring direction) for the two KV half-shards.
+    The first (ceil) half rotates forward, the second backward; a length-0
+    half (s_local == 1) drops out entirely — no calls, no rotations."""
+    h0 = (s_local + 1) // 2
+    return [(start, length, sign)
+            for start, length, sign in ((0, h0, +1), (h0, s_local - h0, -1))
+            if length > 0]
+
+
+def _merge(run_out, run_lse, o, l):
+    """Fold one hop's (out, lse) into the running pair with logsumexp
+    weights (both f32). Skipped hops contribute (0, _LSE_FLOOR) — weight 0."""
+    new_lse = jnp.logaddexp(run_lse, l)
+    w_prev = jnp.exp(run_lse - new_lse)[..., None]
+    w_new = jnp.exp(l - new_lse)[..., None]
+    return w_prev * run_out + w_new * o, new_lse
+
+
+def _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k, interpret):
+    """n-hop bidirectional forward. Returns (out f32, lse f32) — exact full
+    attention for this rank's query shard."""
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    q_start = rank * s_local
+
+    run_out = jnp.zeros((b, h, s_local, d), jnp.float32)
+    run_lse = jnp.full((b, h, s_local), _LSE_FLOOR, jnp.float32)
+
+    halves = _halves(s_local)
+    resident = {sign: (k[:, :, start:start + length],
+                       v[:, :, start:start + length])
+                for start, length, sign in halves}
+
+    for hop in range(n):
+        for start, length, sign in halves:
+            kh, vh = resident[sign]
+            src = (rank - sign * hop) % n  # whose half is resident this hop
+            k_start = src * s_local + start
+
+            def compute(q, kh, vh, k_start=k_start):
+                o, l = flash_attention_lse(
+                    q, kh, vh, causal,
+                    q_start=q_start, k_start=k_start,
+                    block_q=block_q, block_k=block_k, interpret=interpret,
+                )
+                return o.astype(jnp.float32), l
+
+            if causal and hop > 0:
+                # a source strictly later in the sequence is fully masked
+                # for every resident query row — skip the flash call (the
+                # MXU win; the block still rides the ring for later ranks)
+                o, l = lax.cond(
+                    src <= rank,
+                    compute,
+                    lambda q, kh, vh: (
+                        jnp.zeros((b, h, s_local, d), jnp.float32),
+                        jnp.full((b, h, s_local), _LSE_FLOOR, jnp.float32),
+                    ),
+                    q, kh, vh,
+                )
+            else:
+                o, l = compute(q, kh, vh)
+            run_out, run_lse = _merge(run_out, run_lse, o, l)
+        if hop != n - 1:
+            resident = {sign: ring_pass(kv, axis_name, sign)
+                        for sign, kv in resident.items()}
+    return run_out, run_lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring(q, k, v, axis_name, causal, block_q, block_k, interpret):
+    out, _ = _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k, interpret)
+    return out.astype(q.dtype)
+
+
+def _ring_fwd_rule(q, k, v, axis_name, causal, block_q, block_k, interpret):
+    out, lse = _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k, interpret)
+    # residuals are this rank's RESIDENTS only — O(S/cp), the whole point
+    return out.astype(q.dtype), (q, k, v, out.astype(q.dtype), lse)
+
+
+def _ring_bwd_rule(axis_name, causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    q_start = rank * s_local
+
+    dq = jnp.zeros((b, h, s_local, d), jnp.float32)
+    halves = _halves(s_local)
+    # per direction: (k_half, v_half, dk_acc, dv_acc) travel TOGETHER — each
+    # visiting block accumulates every rank's contribution as it tours the
+    # ring, then takes one final hop home to its owner
+    state = {sign: (k[:, :, start:start + length],
+                    v[:, :, start:start + length],
+                    jnp.zeros((b, h, length, d), jnp.float32),
+                    jnp.zeros((b, h, length, d), jnp.float32))
+             for start, length, sign in halves}
+
+    for hop in range(n):
+        for start, length, sign in halves:
+            kh, vh, dkh, dvh = state[sign]
+            src = (rank - sign * hop) % n
+            k_start = src * s_local + start
+
+            def grads(q, kh, vh, out, lse, g, k_start=k_start):
+                return flash_block_grads(
+                    q, kh, vh, out, lse, g, None, causal,
+                    q_start=q_start, k_start=k_start,
+                    block_q=block_q, block_k=block_k, interpret=interpret,
+                )
+
+            if causal and hop > 0:
+                dq_p, dk_p, dv_p = lax.cond(
+                    src <= rank,
+                    grads,
+                    lambda q, kh, vh, out, lse, g, _l=length: (
+                        jnp.zeros((b, h, s_local, d), jnp.float32),
+                        jnp.zeros((b, h, _l, d), jnp.float32),
+                        jnp.zeros((b, h, _l, d), jnp.float32),
+                    ),
+                    q, kh, vh, out, lse, g,
+                )
+            else:
+                dq_p, dk_p, dv_p = grads(q, kh, vh, out, lse, g)
+            dq = dq + dq_p
+            state[sign] = (kh, vh, dkh + dk_p, dvh + dv_p)
+        if hop != n - 1:
+            state = {sign: ring_pass(s, axis_name, sign)
+                     for sign, s in state.items()}
+
+    # final hop: after compute at hop n−1 the resident block belongs to rank
+    # (rank + sign) mod n — one more rotation in the SAME direction lands
+    # every dk/dv accumulator back on its owner (K/V no longer need to ride)
+    homed = {sign: ring_pass((s[2], s[3]), axis_name, sign)
+             for sign, s in state.items()}
+    dk_parts = {sign: kv[0] for sign, kv in homed.items()}
+    dv_parts = {sign: kv[1] for sign, kv in homed.items()}
+    order = [sign for _, _, sign in halves]  # row order: forward half first
+    dk = jnp.concatenate([dk_parts[s] for s in order], axis=2)
+    dv = jnp.concatenate([dv_parts[s] for s in order], axis=2)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring.defvjp(_ring_fwd_rule, _ring_bwd_rule)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Exact attention over a sequence sharded along ``axis_name`` (the
+    ``cp`` mesh axis), one flash call per visiting KV half-block — call
+    under ``shard_map`` with q/k/v = this rank's shard
+    [batch, heads, S/cp, head_dim].
+
+    Bidirectional KV streaming (each direction moves half the volume),
+    causal hop skipping, and a memory-lean backward that re-streams KV
+    instead of saving every visiting block — see the module docstring.
+    Any per-rank length works (odd residual blocks ride the flash kernel's
+    padded path). Differentiable; parity to single-device flash pinned in
+    tests.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected [batch, heads, seq, head_dim], got {q.shape}")
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return _ring(q, k, v, axis_name, causal, block_q, block_k, interpret)
+
+
+def ring_kv_wire_bytes(
+    s_local: int,
+    n_ranks: int,
+    n_heads: int,
+    head_dim: int,
+    batch: int = 1,
+    itemsize: int = 4,
+    bidirectional: bool = True,
+    backward: bool = False,
+) -> int:
+    """EXACT per-rank wire bytes of one ring-attention call (static shapes ⇒
+    counted, not sampled — same contract as ``collectives.ring_wire_bytes``).
+
+    Forward: n−1 hops, each moving this rank's resident K and V halves
+    (both directions together always carry the FULL shard per hop; the
+    bidirectional split halves the per-LINK volume, not the total).
+    Backward: the same K/V re-stream with f32 dk/dv accumulators riding
+    along, plus the final homing hop of the accumulators alone. Causal
+    skipping saves MXU time only — every block still tours the full ring,
+    so wire volume is schedule-determined.
+    """
+    if n_ranks <= 1:
+        return 0
+    h0 = (s_local + 1) // 2
+    halves = [h for h in ((h0, s_local - h0) if bidirectional else (s_local,)) if h]
+    rows = batch * n_heads * head_dim
+    kv_hop = sum(2 * rows * h * itemsize for h in halves)       # k + v
+    if not backward:
+        return (n_ranks - 1) * kv_hop
+    dkv_hop = sum(2 * rows * h * 4 for h in halves)             # f32 dk + dv
+    return (n_ranks - 1) * (kv_hop + dkv_hop) + dkv_hop
+
+
+def causal_keep_fraction(n_ranks: int) -> float:
+    """Fraction of the hop grid causal skipping still EXECUTES: rank r runs
+    r+1 of the n forward-direction hops and 1+r of the n backward-direction
+    hops, so Σ(2r+2) / 2n² = (n+1)/(2n) — asymptotically the causal-mask 2×,
+    realized at the schedule level instead of inside a masked kernel. The
+    docs/TUNING.md savings table is generated from this."""
+    n = int(n_ranks)
+    if n <= 1:
+        return 1.0
+    return (n + 1) / (2 * n)

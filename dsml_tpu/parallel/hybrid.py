@@ -1,4 +1,4 @@
-"""Hybrid-parallel training: pp × dp × fsdp × sp × tp in one jitted mesh
+"""Hybrid-parallel training: pp × dp × fsdp × sp/cp × tp in one jitted mesh
 program.
 
 The composable-mesh-axes design the reference's literature corpus points at
@@ -34,7 +34,19 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["shard_params", "make_hybrid_train_step", "hybrid_loss_fn"]
+from dsml_tpu.parallel.mesh import MeshSpec
+
+__all__ = ["shard_params", "make_hybrid_train_step", "hybrid_loss_fn",
+           "default_attn_impl"]
+
+
+def default_attn_impl(mesh: Mesh) -> str:
+    """What ``attn_impl=None`` resolves to on this mesh: the context-parallel
+    flash ring (``"ring2"``: bidirectional KV streaming, causal hop skip, KV
+    re-streaming backward — ``ops.ring_attention``) when cp is sized, else
+    the exact XLA ring. ONE definition, shared by the train-step builder and
+    any caller (e.g. the example's eval loss) that must match it."""
+    return "ring2" if mesh.shape.get("cp", 1) > 1 else "ring"
 
 
 def shard_params(params, mesh: Mesh, specs) -> dict:
@@ -62,13 +74,21 @@ def gather_fsdp(params, pspecs, axis: str = "fsdp"):
 
 
 def hybrid_loss_fn(
-    model, attn_impl: str = "ring", pp_axis: str | None = None, n_micro: int = 1
+    model, attn_impl: str = "ring", pp_axis: str | None = None, n_micro: int = 1,
+    seq_axis: str = "sp",
 ) -> Callable:
-    """Per-rank loss closure for shard_map over the framework mesh axes."""
+    """Per-rank loss closure for shard_map over the framework mesh axes.
+
+    ``seq_axis`` names the mesh axis the sequence dimension shards over —
+    the legacy ``"sp"`` ring or the ``"cp"`` context-parallel axis; the
+    model's per-rank positions offset by the shard origin on whichever is
+    passed, and the per-rank loss (chunked xent — ``ops/xent.py``) runs on
+    this rank's sequence rows alone, so the [B, S, vocab] logits tensor is
+    never assembled on any chip."""
 
     def loss_fn(params, x, y):
         return model.loss_spmd(
-            params, x, y, tp_axis="tp", sp_axis="sp", attn_impl=attn_impl,
+            params, x, y, tp_axis="tp", sp_axis=seq_axis, attn_impl=attn_impl,
             pp_axis=pp_axis, n_micro=n_micro,
         )
 
@@ -79,7 +99,7 @@ def make_hybrid_train_step(
     model,
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
-    attn_impl: str = "ring",
+    attn_impl: str | None = None,
     grad_accum: int = 1,
     n_microbatches: int = 1,
     schedule: str = "gpipe",
@@ -92,6 +112,19 @@ def make_hybrid_train_step(
     global batch is split into that many microbatches whose gradients
     accumulate on-device before one optimizer update (BASELINE.md's
     "data-parallel AllReduce + grad accumulation" config).
+
+    With mesh cp > 1 (context parallelism) the SEQUENCE dimension shards
+    over the ``cp`` ring: attention streams KV blocks around the axis
+    (``attn_impl=None`` resolves to ``"ring2"`` — the bidirectional flash
+    ring with causal hop skipping and the KV re-streaming backward,
+    ``ops.ring_attention``), per-rank positions offset by the shard origin,
+    and the loss stays sequence-parallel (each rank's chunked xent over its
+    own rows + one pmean) so neither full-length activations nor the
+    [B, S, vocab] logits ever exist on one chip. cp composes with dp/fsdp
+    (and pp/tp) like sp does; sp and cp cannot both exceed 1 — a 2D
+    sequence grid rides tp × sp via ``ops.attention.attention_2d`` instead.
+    Selective remat (``config.remat="mlp"``) composes: the flash residuals
+    each cp rank keeps are O(S/cp).
 
     When the mesh has pp > 1, the transformer block stack additionally runs
     as a pipeline of ``n_microbatches`` per step (params must be the
@@ -135,6 +168,13 @@ def make_hybrid_train_step(
     pp_size = mesh.shape.get("pp", 1)
     pp_axis = "pp" if pp_size > 1 else None
     fsdp_size = mesh.shape.get("fsdp", 1)
+    # ONE definition of the sequence-axis policy (MeshSpec.seq_axis: cp wins
+    # when sized, sp>1 with cp>1 rejected); the batch spec names both axes
+    # so either composes with dp/fsdp
+    seq_axis = MeshSpec.from_mesh(mesh).seq_axis()
+    seq_names = tuple(a for a in ("sp", "cp") if a in mesh.axis_names)
+    if attn_impl is None:
+        attn_impl = default_attn_impl(mesh)
     if schedule == "1f1b" and not pp_axis:
         # silent fallback would let a user "measure 1F1B" on a pipeline-less
         # mesh and actually measure the gpipe path
@@ -142,9 +182,10 @@ def make_hybrid_train_step(
     if schedule == "1f1b" and getattr(model.config, "pp_interleave", 1) > 1:
         raise ValueError("pp_interleave > 1 composes with the gpipe schedule only")
     pspecs = model.param_specs(pp=bool(pp_axis), fsdp=fsdp_size)
-    # fsdp doubles as a data axis (ZeRO): batch rows shard over dp × fsdp
-    batch_spec = P(("dp", "fsdp"), "sp")
-    loss_fn = hybrid_loss_fn(model, attn_impl, pp_axis, n_microbatches)
+    # fsdp doubles as a data axis (ZeRO): batch rows shard over dp × fsdp;
+    # the sequence dim shards over whichever sequence ring is sized
+    batch_spec = P(("dp", "fsdp"), seq_names)
+    loss_fn = hybrid_loss_fn(model, attn_impl, pp_axis, n_microbatches, seq_axis)
     # value= lets loss-reactive transforms (utils.schedules.adaptive_plateau)
     # see the loss; the wrapper makes every optimizer accept it
     optimizer = optax.with_extra_args_support(optimizer)
@@ -155,8 +196,10 @@ def make_hybrid_train_step(
         params = gather_fsdp(params, pspecs)
         # pmean over the batch axes so the per-rank value is the GLOBAL mean
         # loss, replicated on every rank (tp ranks agree by construction of
-        # the vocab-sharded CE; pp ranks via the masked-head psum).
-        return lax.pmean(loss_fn(params, x, y), ("dp", "fsdp", "sp"))
+        # the vocab-sharded CE; pp ranks via the masked-head psum). cp/sp
+        # ranks hold equal-length sequence shards, so the mean of per-rank
+        # means IS the global mean — the sequence-parallel loss.
+        return lax.pmean(loss_fn(params, x, y), ("dp", "fsdp") + seq_names)
 
     sharded_loss = jax.shard_map(
         total_loss,
@@ -198,11 +241,12 @@ def make_hybrid_train_step(
         # auto-lift psums like any replicated param.
         full, fsdp_vjp = jax.vjp(lambda p: gather_fsdp(p, pspecs), params)
         loss, grads_full = model.train_grads_1f1b_spmd(
-            full, x, y, tp_axis="tp", sp_axis="sp", attn_impl=attn_impl,
+            full, x, y, tp_axis="tp", sp_axis=seq_axis, attn_impl=attn_impl,
             pp_axis="pp", n_micro=n_microbatches,
-            # the batch enters P(('dp','fsdp'),'sp'): data varies over fsdp
-            # too (size 1 on fsdp-less meshes, but vma tracking still sees it)
-            batch_axes=("dp", "fsdp", "sp"),
+            # the batch enters P(('dp','fsdp'), seq axes): data varies over
+            # fsdp too (size 1 on fsdp-less meshes, but vma tracking still
+            # sees it)
+            batch_axes=("dp", "fsdp") + seq_names,
         )
         # loss is masked to the last pp rank; batch axes hold genuinely
         # different values (mean them); remaining marked axes (tp) hold
@@ -218,7 +262,7 @@ def make_hybrid_train_step(
         # per-rank value_and_grad + one explicit bucketed sync is only
         # exact when NO collective crosses ranks inside the loss — i.e. a
         # dp-only mesh (psums over the size-1 tp/sp/pp axes are identities)
-        busy = {a: s for a in ("pp", "fsdp", "sp", "tp")
+        busy = {a: s for a in ("pp", "fsdp", "sp", "cp", "tp")
                 if (s := mesh.shape.get(a, 1)) > 1}
         if busy:
             raise ValueError(

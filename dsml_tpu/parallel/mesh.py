@@ -9,7 +9,9 @@ strategy:
     pp   pipeline stages          (outermost: least traffic, coarsest grain)
     dp   data parallelism / ZeRO  (gradient psum)
     fsdp param sharding           (all-gather weights, reduce-scatter grads)
-    sp   sequence/context ring    (ring attention ppermute neighbors)
+    sp   sequence ring (legacy)   (XLA ring attention ppermute neighbors)
+    cp   context parallelism      (flash ring attention: per-layer KV block
+                                   streaming — heavy traffic, near-innermost)
     tp   tensor parallelism       (innermost: highest-bandwidth collectives)
 
 Axis order is laid out so the highest-traffic axes map to adjacent chips on
@@ -31,7 +33,7 @@ from jax.sharding import Mesh
 
 from dsml_tpu.utils.config import Config, field
 
-AXES = ("pp", "dp", "fsdp", "sp", "tp")
+AXES = ("pp", "dp", "fsdp", "sp", "cp", "tp")
 
 
 @dataclasses.dataclass
@@ -39,22 +41,41 @@ class MeshSpec(Config):
     pp: int = field(1, help="pipeline-parallel stages")
     dp: int = field(0, help="data-parallel size (0 = absorb remaining devices)")
     fsdp: int = field(1, help="fully-sharded data-parallel (param sharding) size")
-    sp: int = field(1, help="sequence/context-parallel ring size")
+    sp: int = field(1, help="sequence-parallel ring size (XLA online-softmax ring)")
+    cp: int = field(1, help="context-parallel ring size (flash ring attention)")
     tp: int = field(1, help="tensor-parallel size")
 
     def resolved(self, n_devices: int) -> "MeshSpec":
         """Fill dp=0 with whatever devices remain after the fixed axes."""
-        fixed = self.pp * self.fsdp * self.sp * self.tp
+        fixed = self.pp * self.fsdp * self.sp * self.cp * self.tp
         dp = self.dp
         if dp == 0:
             if n_devices % fixed:
-                raise ValueError(f"{n_devices} devices not divisible by pp*fsdp*sp*tp={fixed}")
+                raise ValueError(f"{n_devices} devices not divisible by pp*fsdp*sp*cp*tp={fixed}")
             dp = n_devices // fixed
         if dp * fixed != n_devices:
             raise ValueError(
                 f"mesh {self.sizes_dict() | {'dp': dp}} needs {dp * fixed} devices, have {n_devices}"
             )
         return dataclasses.replace(self, dp=dp)
+
+    @classmethod
+    def from_mesh(cls, mesh: Mesh) -> "MeshSpec":
+        """The spec a live mesh realizes (absent axes = size 1) — the one
+        conversion the hybrid step and the elastic controller both use."""
+        return cls(**{a: mesh.shape.get(a, 1) for a in AXES})
+
+    def seq_axis(self) -> str:
+        """The mesh axis the SEQUENCE dimension shards over — ``cp`` (flash
+        ring attention) when cp > 1, else the legacy ``sp`` ring. At most one
+        may exceed 1: composing two sequence rings needs the 2D attention
+        grid, which rides ``tp × sp`` (``ops.attention.attention_2d``)."""
+        if self.sp > 1 and self.cp > 1:
+            raise ValueError(
+                f"sp={self.sp} and cp={self.cp} both >1: pick ONE sequence "
+                "ring (2D sequence grids ride tp × sp via attention_2d)"
+            )
+        return "cp" if self.cp > 1 else "sp"
 
     def sizes_dict(self) -> dict:
         return {a: getattr(self, a) for a in AXES}
@@ -113,12 +134,12 @@ def _multislice_layout(devices, spec: MeshSpec) -> np.ndarray:
     per_slice = [len(v) for v in slices.values()]
     if len(set(per_slice)) != 1:
         raise ValueError(f"unequal slice sizes {per_slice}; a mesh needs a rectangle")
-    inner = spec.pp * spec.fsdp * spec.sp * spec.tp
+    inner = spec.pp * spec.fsdp * spec.sp * spec.cp * spec.tp
     if spec.dp % n_slices:
         raise ValueError(f"dp={spec.dp} not divisible by n_slices={n_slices}")
     if inner * (spec.dp // n_slices) != per_slice[0]:
         raise ValueError(
-            f"non-dp axes (pp*fsdp*sp*tp={inner}) x per-slice dp "
+            f"non-dp axes (pp*fsdp*sp*cp*tp={inner}) x per-slice dp "
             f"({spec.dp // n_slices}) must fill one slice ({per_slice[0]} devices); "
             "shrink tp/sp/pp so they fit inside a slice — crossing the DCN with "
             "them defeats the point of the multislice layout"
@@ -130,5 +151,7 @@ def _multislice_layout(devices, spec: MeshSpec) -> np.ndarray:
     shape = tuple(getattr(spec, a) for a in AXES)
     arr = np.empty(len(ordered), dtype=object)
     arr[:] = ordered
-    arr = arr.reshape(n_slices, spec.dp // n_slices, spec.pp, spec.fsdp, spec.sp, spec.tp)
-    return arr.transpose(2, 0, 1, 3, 4, 5).reshape(shape)
+    arr = arr.reshape(
+        n_slices, spec.dp // n_slices, spec.pp, spec.fsdp, spec.sp, spec.cp, spec.tp
+    )
+    return arr.transpose(2, 0, 1, 3, 4, 5, 6).reshape(shape)

@@ -137,7 +137,10 @@ class ControllerConfig(Config):
         "bundle (0 = DSML_RECOVERY_DEADLINE_S, default 120)"
     )
     batch_per_device: int = field(1, help="forwarded to the elastic re-plan")
-    attn_impl: str = field("ring", help="attention impl for rebuilt steps")
+    attn_impl: str = field("", help="attention impl for rebuilt steps ('' = "
+                           "per-mesh auto: ring2 on cp meshes, ring otherwise "
+                           "— a pinned 'ring' on a cp mesh would lose ring2's "
+                           "O(S/cp) residual property on every reconfigure)")
 
     def resolved_recovery_deadline_s(self) -> float:
         if self.recovery_deadline_s > 0:
@@ -200,7 +203,7 @@ class ElasticController:
         self.non_addressable = tuple(non_addressable)
         self._step_factory = step_factory or (
             lambda mdl, opt, m: make_hybrid_train_step(
-                mdl, opt, m, attn_impl=self.config.attn_impl
+                mdl, opt, m, attn_impl=self.config.attn_impl or None
             )
         )
         self._failure_feed = failure_feed
@@ -376,8 +379,7 @@ class ElasticController:
 
     @staticmethod
     def _spec_of(mesh) -> MeshSpec:
-        sizes = {a: mesh.shape.get(a, 1) for a in ("pp", "dp", "fsdp", "sp", "tp")}
-        return MeshSpec(**sizes)
+        return MeshSpec.from_mesh(mesh)
 
     def _get_step_fn(self, mesh, spec: MeshSpec):
         key = (tuple(d.id for d in mesh.devices.flat),

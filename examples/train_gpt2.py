@@ -2,11 +2,12 @@
 BASELINE.json config #5 ("TinyStories GPT-2-small, data-parallel AllReduce +
 grad accumulation").
 
-One jitted step over a pp×dp×sp×tp mesh: GPipe pipeline stages (``--pp``),
-Megatron tensor parallelism, ring (or Ulysses) sequence-parallel attention,
-data-parallel batch sharding with on-device gradient accumulation — the full
-hybrid-parallelism roadmap the reference carried only as literature
-(SURVEY.md §2.3).
+One jitted step over a pp×dp×sp/cp×tp mesh: GPipe pipeline stages (``--pp``),
+Megatron tensor parallelism, ring (or Ulysses) sequence-parallel attention —
+``--cp N`` picks the context-parallel flash ring for 128k-token-class
+sequences (``ops/ring_attention.py``) — data-parallel batch sharding with
+on-device gradient accumulation: the full hybrid-parallelism roadmap the
+reference carried only as literature (SURVEY.md §2.3).
 
 Token source: ``--data`` can point at any UTF-8 text file (e.g. a
 TinyStories dump). Without one (this container has no egress), a
@@ -58,9 +59,10 @@ class GPT2TrainConfig(Config):
     schedule: str = field("gpipe", help="pipeline schedule (pp > 1): gpipe | 1f1b")
     n_micro: int = field(2, help="pipeline microbatches per step (pp > 1)")
     dp: int = field(0, help="data-parallel size (0 = derive from devices)")
-    sp: int = field(1, help="sequence-parallel size")
+    sp: int = field(1, help="sequence-parallel size (legacy XLA ring)")
+    cp: int = field(1, help="context-parallel size (flash ring attention: bidirectional KV streaming + causal hop skip + KV re-streaming backward; docs/TUNING.md § Context parallelism)")
     tp: int = field(1, help="tensor-parallel size")
-    attn: str = field("ring", help="attention impl: ring | ulysses | ulysses_flash | ring_flash | flash | xla (flash variants = Pallas kernels)")
+    attn: str = field("", help="attention impl: ring | ring2 | ulysses | ulysses_flash | ring_flash | flash | xla ('' = auto: ring2 on cp meshes, ring otherwise)")
     lr: float = field(3e-4, help="peak learning rate")
     optimizer: str = field("adamw", help="adamw | adafactor (factored second "
                            "moments — O(rows+cols) state instead of two full "
@@ -115,9 +117,11 @@ def main(argv=None):
 
     log = get_logger("gpt2")
     devices = jax.devices()
-    dp = cfg.dp or max(len(devices) // (cfg.pp * cfg.sp * cfg.tp), 1)
-    n_used = cfg.pp * dp * cfg.sp * cfg.tp
-    mesh = build_mesh(MeshSpec(pp=cfg.pp, dp=dp, sp=cfg.sp, tp=cfg.tp), devices[:n_used])
+    dp = cfg.dp or max(len(devices) // (cfg.pp * cfg.sp * cfg.cp * cfg.tp), 1)
+    n_used = cfg.pp * dp * cfg.sp * cfg.cp * cfg.tp
+    mesh = build_mesh(
+        MeshSpec(pp=cfg.pp, dp=dp, sp=cfg.sp, cp=cfg.cp, tp=cfg.tp), devices[:n_used]
+    )
 
     # the batch must split evenly: global batch → grad_accum microbatches →
     # dp shards → (pp>1) pipeline microbatches
@@ -255,8 +259,8 @@ def main(argv=None):
     else:
         raise SystemExit(f"unknown --optimizer {cfg.optimizer!r} (adamw | adafactor)")
     step = make_hybrid_train_step(
-        model, optimizer, mesh, attn_impl=cfg.attn, grad_accum=cfg.grad_accum,
-        n_microbatches=n_micro, schedule=cfg.schedule,
+        model, optimizer, mesh, attn_impl=cfg.attn or None,
+        grad_accum=cfg.grad_accum, n_microbatches=n_micro, schedule=cfg.schedule,
     )
     params, opt_state = init_hybrid(model, optimizer, mesh, seed=cfg.seed)
     if ckpt is not None and start_step > 0:
@@ -265,9 +269,9 @@ def main(argv=None):
         log.info("resumed from checkpoint at step %d", start_step)
     n_params = model.n_params(params)
     log.info(
-        "%s %s: %.1fM params, mesh pp=%d dp=%d sp=%d tp=%d, seq=%d, batch=%d x accum=%d",
+        "%s %s: %.1fM params, mesh pp=%d dp=%d sp=%d cp=%d tp=%d, seq=%d, batch=%d x accum=%d",
         "Llama" if cfg.family == "llama" else "GPT-2", cfg.model, n_params / 1e6,
-        cfg.pp, dp, cfg.sp, cfg.tp, seq, cfg.batch_size, cfg.grad_accum,
+        cfg.pp, dp, cfg.sp, cfg.cp, cfg.tp, seq, cfg.batch_size, cfg.grad_accum,
     )
 
     import contextlib
@@ -281,12 +285,18 @@ def main(argv=None):
 
         from dsml_tpu.parallel.hybrid import hybrid_loss_fn
 
-        _lf = hybrid_loss_fn(model, cfg.attn, "pp" if cfg.pp > 1 else None, n_micro)
+        from dsml_tpu.parallel.hybrid import default_attn_impl
+
+        seq_axis = MeshSpec.from_mesh(mesh).seq_axis()
+        eval_impl = cfg.attn or default_attn_impl(mesh)
+        _lf = hybrid_loss_fn(model, eval_impl, "pp" if cfg.pp > 1 else None,
+                             n_micro, seq_axis)
         eval_loss_fn = jax.jit(
             jax.shard_map(
-                lambda p, x, y: lax.pmean(_lf(p, x, y), ("dp", "sp")),
+                lambda p, x, y: lax.pmean(_lf(p, x, y), ("dp", seq_axis)),
                 mesh=mesh,
-                in_specs=(model.param_specs(pp=cfg.pp > 1), P("dp", "sp"), P("dp", "sp")),
+                in_specs=(model.param_specs(pp=cfg.pp > 1), P("dp", seq_axis),
+                          P("dp", seq_axis)),
                 out_specs=P(),
                 check_vma=False,
             )

@@ -49,6 +49,9 @@ SECTIONS = [
     ("paged_kv", 900),  # paged int4 KV cache vs dense at equal HBM
     #                     (virtual-8 CPU subprocess; capacity-ratio +
     #                     bit-identity verdicts are the signal)
+    ("long_context", 3000),  # cp=8 ring-attention ladder to 128k tokens
+    #                          (virtual-8 CPU subprocess; completion, exact
+    #                          KV wire bytes, headroom + parity verdicts)
     ("gpt2_decode", 1200),  # plain + wq8 + kv8 + kv4 variants, 2 compiles each
     ("allreduce", 600),   # incl. the e2e wire-path row (VERDICT r3 item 7)
     ("gpt2_seq8k", 900),

@@ -253,13 +253,13 @@ def test_multislice_layout_dp_spans_slices():
 
     devs = [_Dev(i, i // 4) for i in range(8)]
     arr = _multislice_layout(devs, MeshSpec(dp=4, tp=2).resolved(8))
-    assert arr.shape == (1, 4, 1, 1, 2)
+    assert arr.shape == (1, 4, 1, 1, 1, 2)
     # every tp pair within one slice
     for dp_i in range(4):
-        pair = arr[0, dp_i, 0, 0, :]
+        pair = arr[0, dp_i, 0, 0, 0, :]
         assert pair[0].slice_index == pair[1].slice_index, arr
     # dp index 0,1 → slice 0; dp index 2,3 → slice 1 (slice-major)
-    assert [arr[0, i, 0, 0, 0].slice_index for i in range(4)] == [0, 0, 1, 1]
+    assert [arr[0, i, 0, 0, 0, 0].slice_index for i in range(4)] == [0, 0, 1, 1]
 
 
 def test_multislice_layout_rejects_tp_across_dcn():
@@ -280,7 +280,7 @@ def test_multislice_mesh_single_slice_trains(devices8):
     from dsml_tpu.parallel.mesh import MeshSpec, multislice_mesh
 
     mesh = multislice_mesh(MeshSpec(dp=4, tp=2), devices8)
-    assert dict(mesh.shape) == {"pp": 1, "dp": 4, "fsdp": 1, "sp": 1, "tp": 2}
+    assert dict(mesh.shape) == {"pp": 1, "dp": 4, "fsdp": 1, "sp": 1, "cp": 1, "tp": 2}
     xs = np.arange(8, dtype=np.float32).reshape(4, 2)
 
     out = jax.jit(

@@ -55,12 +55,100 @@ def test_flash_jits_and_handles_bf16():
     )
 
 
-def test_flash_falls_back_on_untileable_seq():
-    # seq=37 has no valid block — must silently use the fused-XLA path
+def test_flash_handles_untileable_seq_via_padded_kernel():
+    """seq=37 tiles into NO ladder block — it must run through the padded
+    kernel path (zero-pad + kv_stop mask), not fall back to the O(s²) XLA
+    graph. flash_attention_lse USED to raise here; now it is the proof the
+    kernel itself ran (the XLA fallback had no lse output)."""
+    from dsml_tpu.ops.flash import flash_attention_lse
+
     q, k, v = _qkv(s=37, seed=3)
     expected = np.asarray(attention(q, k, v, True))
     got = np.asarray(flash_attention(q, k, v, True))
     np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
+    out, lse = flash_attention_lse(q, k, v, True)
+    assert lse.shape == (2, 3, 37)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5, atol=1e-5)
+
+
+# ring/cp shards make odd residual blocks the COMMON case: lengths that are
+# not multiples of block_q/block_k, and S < the smallest block
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("seq", [5, 37, 100, 515])
+def test_flash_odd_length_forward_matches_attention(causal, seq):
+    q, k, v = _qkv(s=seq, seed=seq)
+    expected = np.asarray(attention(q, k, v, causal))
+    got = np.asarray(flash_attention(q, k, v, causal))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("seq", [5, 37, 100])
+def test_flash_odd_length_backward_matches_attention(causal, seq):
+    """Backward parity through the padded path: padded q rows carry zero
+    cotangents and padded kv columns are kv_stop-masked in BOTH backward
+    kernels, so dq/dk/dv must equal the dense reference exactly."""
+    q, k, v = _qkv(s=seq, seed=seq + 1)
+    w = jnp.cos(jnp.arange(q.shape[-1]))
+    flash_grads = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, causal) * w).sum(), argnums=(0, 1, 2)
+    )(q, k, v)
+    ref_grads = jax.grad(
+        lambda q, k, v: (attention(q, k, v, causal) * w).sum(), argnums=(0, 1, 2)
+    )(q, k, v)
+    for got, expected in zip(flash_grads, ref_grads):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-4, atol=1e-4)
+
+
+def test_flash_odd_mismatched_lengths():
+    """s_q ≠ s_kv with BOTH odd (the ring's diagonal-half shape): non-causal
+    directly, causal via the q_start offset that aligns sequence ENDS (the
+    dense reference's tril(k=s_kv−s_q) convention)."""
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.standard_normal((1, 2, 27, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 2, 53, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 2, 53, 32)), jnp.float32)
+    from dsml_tpu.ops.flash import flash_attention_lse
+
+    got, _ = flash_attention_lse(q, k, v, causal=False)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(attention(q, k, v, False)), rtol=1e-5, atol=1e-5
+    )
+    got, _ = flash_attention_lse(q, k, v, causal=True, q_start=53 - 27, k_start=0)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(attention(q, k, v, True)), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_flash_odd_length_lse_matches_dense(causal=True):
+    from dsml_tpu.ops.flash import flash_attention_lse
+
+    q, k, v = _qkv(s=45, seed=12)
+    _, lse = flash_attention_lse(q, k, v, causal)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (q.shape[-1] ** -0.5)
+    scores = jnp.where(jnp.tril(jnp.ones((45, 45), bool)), scores, -1e30)
+    expected = jax.scipy.special.logsumexp(scores, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(expected), rtol=1e-5, atol=1e-5)
+
+
+def test_flash_block_env_override(monkeypatch):
+    """DSML_FLASH_BLOCK promotes the hardcoded widening heuristic to a
+    tunable: valid values override the auto defaults, explicit arguments
+    still win, malformed values degrade to the swept defaults."""
+    from dsml_tpu.ops.flash import _default_blocks
+
+    monkeypatch.setenv("DSML_FLASH_BLOCK", "256")
+    assert _default_blocks(8192, 8192, None, None, 64) == (256, 256)
+    monkeypatch.setenv("DSML_FLASH_BLOCK", "128x512")
+    assert _default_blocks(8192, 8192, None, None, 64) == (128, 512)
+    # explicit blocks are never second-guessed
+    assert _default_blocks(8192, 8192, 1024, None, 64) == (1024, 512)
+    # malformed / non-multiple-of-8 → the swept defaults stand
+    for bad in ("abc", "0", "12", "-8", "64x"):
+        monkeypatch.setenv("DSML_FLASH_BLOCK", bad)
+        assert _default_blocks(8192, 8192, None, None, 64) == (1024, 1024)
+    monkeypatch.delenv("DSML_FLASH_BLOCK")
+    assert _default_blocks(8192, 8192, None, None, 64) == (1024, 1024)
 
 
 @pytest.mark.parametrize("causal", [True, False])
