@@ -144,6 +144,10 @@ class StateDonor:
     def __init__(self, runtime, stage_addr: int | None = None):
         self.runtime = runtime
         self._arrays: dict[str, np.ndarray] = {}
+        # per-key request trace identity (serving KV handoffs register
+        # per-request transients): carried in plan/stream descriptors so
+        # the cross-host pull stays attributable to ONE request's trace
+        self._trace_ids: dict[str, str] = {}
         if stage_addr is None:
             # default to the UPPER half of the registry: the lower half is
             # where a ShardMigrator on this same host lands INCOMING pieces
@@ -166,8 +170,13 @@ class StateDonor:
 
     # -- registration ------------------------------------------------------
 
-    def register_array(self, key: str, arr) -> None:
+    def register_array(self, key: str, arr,
+                       trace_id: str | None = None) -> None:
         self._arrays[key] = np.asarray(arr)
+        if trace_id is not None:
+            self._trace_ids[key] = str(trace_id)
+        else:
+            self._trace_ids.pop(key, None)
 
     def register_state(self, tree, prefix: str = "state",
                        version=None) -> int:
@@ -208,6 +217,7 @@ class StateDonor:
                   if k == prefix or k.startswith(prefix + "/")]
         for k in doomed:
             del self._arrays[k]
+            self._trace_ids.pop(k, None)
         return len(doomed)
 
     # -- piece serving -----------------------------------------------------
@@ -219,11 +229,15 @@ class StateDonor:
         out = {}
         for key in keys:
             arr = self._arrays.get(key)
-            out[key] = (
-                {"shape": list(arr.shape), "dtype": str(arr.dtype),
-                 "version": self.version}
-                if arr is not None else None
-            )
+            if arr is None:
+                out[key] = None
+                continue
+            info = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                    "version": self.version}
+            trace_id = self._trace_ids.get(key)
+            if trace_id is not None:  # request-scoped keys only (handoffs)
+                info["trace_id"] = trace_id
+            out[key] = info
         return out
 
     def _prune_stages_locked(self) -> None:
@@ -320,6 +334,8 @@ class StateDonor:
                 "dtype": str(sub.dtype),
                 "shape": list(sub.shape),
                 "version": self.version,
+                **({"trace_id": self._trace_ids[key]}
+                   if key in self._trace_ids else {}),
             })
             log.info(
                 "donor: piece %s %s -> rank %d (stream %d, %d B from offset %d)",
@@ -526,10 +542,13 @@ class ShardMigrator:
 
     # -- the per-piece pull ------------------------------------------------
 
-    def fetch_piece(self, key: str, piece, dtype) -> np.ndarray:
+    def fetch_piece(self, key: str, piece, dtype,
+                    trace_id: str | None = None) -> np.ndarray:
         """Pull one piece (``piece`` = ((start, stop), ...) per dim) of leaf
         ``key`` over P2P streams; returns the typed array in piece shape.
-        Raises :class:`MigrationError` when no donor can deliver."""
+        Raises :class:`MigrationError` when no donor can deliver.
+        ``trace_id`` tags the flight-recorder event with the request trace
+        this piece belongs to (the serving KV-handoff pull path)."""
         piece = [[int(s), int(e)] for s, e in piece]
         t0 = time.perf_counter()
         donors = self._donors_holding(key)
@@ -574,9 +593,10 @@ class ShardMigrator:
                         "migration_pieces_total",
                         "shard-migration piece outcomes", labels=("outcome",),
                     ).inc(outcome="migrated")
+                extra = {"trace_id": trace_id} if trace_id else {}
                 flight_recorder.record(
                     "migration_piece", key=key, bytes=len(data),
-                    ms=round(ms, 3), donor=donor.address,
+                    ms=round(ms, 3), donor=donor.address, **extra,
                 )
                 expect_shape = tuple(e - s for s, e in piece)
                 try:

@@ -46,6 +46,22 @@ Cluster plane (``docs/OBSERVABILITY.md`` § Cluster):
   dsml_tpu.obs.regress`` exits nonzero on regression and exports the
   calibrated collective-latency profile for the cost-model planner.
 
+Request tracing + SLO budgets (``docs/OBSERVABILITY.md`` § Request
+tracing & SLO budgets):
+
+- :class:`~dsml_tpu.obs.spans.TraceContext` — request-scoped trace
+  identity minted at ``Router.submit`` and propagated through prefill
+  dispatch, the handoff codec/donor headers, decode injection, and
+  retire/requeue; every stage emits trace-tagged spans + Chrome flow
+  events so the stitched timeline renders one request as a causal chain.
+- :mod:`~dsml_tpu.obs.slo` — per-SLOClass SLI windows, rolling error
+  budgets with multi-window (fast/slow) burn-rate status, per-class
+  goodput counters, and the p99 tail-attribution report (which stage —
+  queue/prefill/handoff/first-decode/decode — dominates the tail);
+  merged fleet-wide by ``MergedView.report()``. Tail-bucket histogram
+  samples carry trace_id EXEMPLARS in the JSONL/``/metrics.json``
+  expositions.
+
 Metric names, label sets, and the span taxonomy are specified in
 ``docs/OBSERVABILITY.md``.
 """
@@ -80,7 +96,12 @@ from dsml_tpu.obs.sentinels import (  # noqa: F401
     SentinelTripped,
     TrainingSentinels,
 )
-from dsml_tpu.obs.spans import SpanTracer, get_tracer, span  # noqa: F401
+from dsml_tpu.obs.spans import (  # noqa: F401
+    SpanTracer,
+    TraceContext,
+    get_tracer,
+    span,
+)
 from dsml_tpu.obs.step_stats import (  # noqa: F401
     STEP_PHASES,
     GoodputTracker,
@@ -92,7 +113,7 @@ __all__ = [
     "Registry", "Counter", "Gauge", "Histogram", "ObsUnavailable",
     "get_registry", "enable", "disable", "enabled",
     "DEFAULT_LATENCY_BUCKETS_MS",
-    "SpanTracer", "span", "get_tracer",
+    "SpanTracer", "TraceContext", "span", "get_tracer",
     "StepBreakdown", "GoodputTracker", "mfu", "STEP_PHASES",
     "MetricsLogger", "MetricsServer", "start_metrics_server",
     "record_collective_plan", "observe_collective_latency_ms",
@@ -101,14 +122,14 @@ __all__ = [
     "SentinelConfig", "SentinelTripped", "TrainingSentinels",
     "HangWatch", "TrailingDeadline", "get_hangwatch",
     "ClockSync", "ClusterAggregator", "merge_snapshots", "snapshot",
-    "stitch_traces",
+    "stitch_traces", "trace_summary",
 ]
 
 # cluster-plane names resolve lazily (PEP 562): ``python -m
 # dsml_tpu.obs.cluster`` would otherwise warn about the module being
 # imported as a side effect of its own package __init__
 _CLUSTER_NAMES = ("ClockSync", "ClusterAggregator", "merge_snapshots",
-                  "snapshot", "stitch_traces")
+                  "snapshot", "stitch_traces", "trace_summary")
 
 
 def __getattr__(name: str):

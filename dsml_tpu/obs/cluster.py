@@ -46,6 +46,7 @@ from dsml_tpu.obs.registry import (
     _fmt_num,
     get_registry,
 )
+from dsml_tpu.obs.slo import STATUS_LEVELS, burn_rate
 
 __all__ = [
     "SNAPSHOT_SCHEMA",
@@ -56,6 +57,7 @@ __all__ = [
     "merge_snapshots",
     "snapshot",
     "stitch_traces",
+    "trace_summary",
     "validate_snapshot",
 ]
 
@@ -186,7 +188,7 @@ def estimate_quantile(bounds: tuple, cum_counts: dict, q: float) -> float | None
 
 
 class _MergedHist:
-    __slots__ = ("bounds", "counts", "sum", "count", "conflict")
+    __slots__ = ("bounds", "counts", "sum", "count", "conflict", "exemplars")
 
     def __init__(self, bounds: tuple):
         self.bounds = bounds
@@ -194,6 +196,7 @@ class _MergedHist:
         self.sum = 0.0
         self.count = 0
         self.conflict = False  # a contributor's bounds didn't match
+        self.exemplars: dict = {}  # bucket bound -> newest exemplar
 
     def add(self, rec: dict) -> bool:
         if _bounds_of(rec) != self.bounds:
@@ -203,6 +206,12 @@ class _MergedHist:
             self.counts[i] += c
         self.sum += rec["sum"]
         self.count += rec["count"]
+        # exemplars survive the merge (newest wall-clock wins per bucket):
+        # a FLEET tail bucket still resolves to a concrete trace_id
+        for bound, ex in (rec.get("exemplars") or {}).items():
+            prev = self.exemplars.get(bound)
+            if prev is None or ex.get("time", 0) >= prev.get("time", 0):
+                self.exemplars[bound] = ex
         return True
 
     def cumulative(self) -> dict:
@@ -343,6 +352,63 @@ class MergedView:
                                       and len(rows) > 1)
         return rows
 
+    def slo_status(self) -> dict:
+        """Fleet-wide SLO accounting from the merged ``slo_*`` series
+        (written per process by ``obs.slo.SLOTracker`` — the serving
+        router's request accounting). Counters merge EXACTLY, so per-class
+        per-SLI compliance and the all-time burn are true fleet numbers;
+        the rolling multi-window status is per-process state, so the
+        fleet status is the WORST process's (max of the
+        ``slo_burn_status`` gauges — a paging replica pages the fleet)."""
+        classes: dict[str, dict] = {}
+
+        def cls_row(name: str) -> dict:
+            return classes.setdefault(
+                name, {"objective": None, "requests": 0, "good_requests": 0,
+                       "sli": {}, "status": "ok"}
+            )
+
+        for (name, labels), v in self._fleet_counters.items():
+            ld = dict(labels)
+            if name == "slo_requests_total" and "slo" in ld:
+                cls_row(ld["slo"])["requests"] = int(v)
+            elif name == "slo_good_total" and "slo" in ld:
+                cls_row(ld["slo"])["good_requests"] = int(v)
+            elif name == "slo_sli_total" and {"slo", "sli", "verdict"} <= set(ld):
+                sli = cls_row(ld["slo"])["sli"].setdefault(
+                    ld["sli"], {"good": 0, "bad": 0}
+                )
+                sli[ld["verdict"]] = sli.get(ld["verdict"], 0) + int(v)
+        levels = STATUS_LEVELS  # one ladder — obs.slo owns the encoding
+        names = {v: k for k, v in levels.items()}
+        for rec in self._proc_series:
+            if rec["type"] != "gauge":
+                continue
+            ld = rec["labels"]
+            if rec["name"] == "slo_objective" and ld.get("slo") in classes:
+                classes[ld["slo"]]["objective"] = float(rec["value"])
+            elif rec["name"] == "slo_burn_status" and ld.get("slo") in classes:
+                row = classes[ld["slo"]]
+                level = int(rec["value"])
+                if level > levels[row["status"]]:
+                    row["status"] = names.get(level, "page")
+                sli = row["sli"].setdefault(ld.get("sli", "?"), {})
+                worst = sli.get("status", "ok")
+                if level > levels.get(worst, 0):
+                    sli["status"] = names.get(level, "page")
+        for row in classes.values():
+            obj = row["objective"]
+            for sli in row["sli"].values():
+                total = sli.get("good", 0) + sli.get("bad", 0)
+                if total:
+                    sli["compliance"] = round(sli.get("good", 0) / total, 6)
+                    if obj is not None and obj < 1.0:
+                        sli["burn_total"] = round(
+                            burn_rate(sli.get("bad", 0) / total, obj), 4
+                        )
+                sli.setdefault("status", "ok")
+        return classes
+
     # -- exposition --------------------------------------------------------
 
     def collect(self) -> list[dict]:
@@ -354,9 +420,12 @@ class MergedView:
         for (name, labels), h in sorted(self._fleet_hists.items()):
             if h.conflict:
                 continue
-            out.append({"name": f"{name}:fleet", "type": "histogram",
-                        "labels": dict(labels), "buckets": h.cumulative(),
-                        "sum": h.sum, "count": h.count})
+            rec = {"name": f"{name}:fleet", "type": "histogram",
+                   "labels": dict(labels), "buckets": h.cumulative(),
+                   "sum": h.sum, "count": h.count}
+            if h.exemplars:
+                rec["exemplars"] = dict(h.exemplars)
+            out.append(rec)
         g = self.fleet_goodput()
         if g is not None:
             out.append({"name": "fleet_goodput", "type": "gauge",
@@ -424,6 +493,7 @@ class MergedView:
             "fleet_goodput": self.fleet_goodput(),
             "stragglers": self.straggler_ranking(),
             "gauges": gauge_rows,
+            "slo": self.slo_status(),
             "notes": self.notes,
         }
 
@@ -502,6 +572,33 @@ def stitch_traces(snaps: list[dict],
     timed.sort(key=lambda e: e["ts"])
     meta = [e for e in events if e["ph"] == "M"]
     return {"traceEvents": meta + timed, "displayTimeUnit": "ms"}
+
+
+def trace_summary(trace: dict) -> dict:
+    """Per-request causal chains from a (stitched or single-process)
+    Chrome trace: {trace_id: {pids, names, flow}} for every event tagged
+    with a ``trace_id`` arg (request spans, instants, flow events —
+    ``obs.spans.TraceContext`` propagation). ``flow`` counts the flow
+    phases seen (``s``/``t``/``f``) — a fully linked request shows one
+    start, ≥1 step, one end; ``pids`` is the set of process lanes the
+    request's events landed in (the ≥3-process acceptance reads this)."""
+    out: dict[str, dict] = {}
+    for e in trace.get("traceEvents", []):
+        tid = (e.get("args") or {}).get("trace_id")
+        if not tid:
+            continue
+        row = out.setdefault(
+            tid, {"pids": set(), "names": [], "flow": {}, "n_events": 0}
+        )
+        row["pids"].add(e.get("pid"))
+        row["n_events"] += 1
+        if e.get("ph") in ("s", "t", "f"):
+            row["flow"][e["ph"]] = row["flow"].get(e["ph"], 0) + 1
+        elif e.get("ph") in ("B", "i") and e.get("name") not in row["names"]:
+            row["names"].append(e["name"])
+    for row in out.values():
+        row["pids"] = sorted(row["pids"])
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -10,6 +10,14 @@
   Chrome trace + clock reading) at ``/cluster.json`` — the scrape surface
   ``obs.cluster.ClusterAggregator`` merges fleet-wide (scrape-able by
   Prometheus or curl; nothing listens unless a caller asks).
+
+Histogram records in the JSON expositions (``/metrics.json``, JSONL,
+``/cluster.json``) carry per-bucket trace_id EXEMPLARS when the emitting
+call site attached them (``Histogram.observe(..., exemplar=trace_id)`` —
+the serving TTFT/TPOT/admission histograms do), so a scraped tail bucket
+resolves to a concrete request trace (docs/OBSERVABILITY.md § Request
+tracing & SLO budgets). Prometheus 0.0.4 text has no exemplar syntax;
+they ride the JSON forms only.
 """
 
 from __future__ import annotations

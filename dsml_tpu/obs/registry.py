@@ -27,6 +27,7 @@ import json
 import os
 import threading
 import time
+import weakref
 
 __all__ = [
     "ObsUnavailable",
@@ -87,6 +88,25 @@ class _Metric:
             return list(self._series.items())
 
 
+class _BoundCounter:
+    """One labeled counter series with the label key resolved ONCE —
+    the fast path for per-request hot paths (``Counter.bind``): an inc
+    costs the enabled branch + one lock, no per-call label validation."""
+
+    __slots__ = ("_counter", "_key")
+
+    def __init__(self, counter: "Counter", key: tuple):
+        self._counter = counter
+        self._key = key
+
+    def inc(self, value: float = 1.0) -> None:
+        c = self._counter
+        if not c._registry._enabled:
+            return
+        with c._lock:
+            c._series[self._key] = c._series.get(self._key, 0.0) + value
+
+
 class Counter(_Metric):
     """Monotonically increasing count (events, bytes, errors)."""
 
@@ -100,6 +120,11 @@ class Counter(_Metric):
         key = _label_key(self.label_names, labels)
         with self._lock:
             self._series[key] = self._series.get(key, 0.0) + value
+
+    def bind(self, **labels) -> _BoundCounter:
+        """Pre-resolve a label set (validated HERE, once) into a bound
+        series handle whose ``inc`` skips per-call label work."""
+        return _BoundCounter(self, _label_key(self.label_names, labels))
 
     def value(self, **labels) -> float:
         with self._lock:
@@ -125,13 +150,19 @@ class Gauge(_Metric):
 
 
 class _HistSeries:
-    __slots__ = ("counts", "sum", "count", "samples")
+    __slots__ = ("counts", "sum", "count", "samples", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * (n_buckets + 1)  # +1 = the +Inf overflow bucket
         self.sum = 0.0
         self.count = 0
         self.samples = collections.deque(maxlen=_SAMPLE_CAP)
+        # bucket index -> {"value", "trace_id", "time"}: the LAST traced
+        # sample that landed in each bucket. One dict per bucket (not a
+        # tail list) bounds memory while guaranteeing the interesting
+        # property: a tail bucket's count always resolves to a concrete
+        # trace_id — "what request WAS that p99?" has an answer
+        self.exemplars: dict[int, dict] = {}
 
 
 class Histogram(_Metric):
@@ -150,7 +181,12 @@ class Histogram(_Metric):
             raise ValueError(f"histogram {name} needs at least one bucket bound")
         self.buckets = bounds
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: str | None = None,
+                **labels) -> None:
+        """``exemplar`` (a trace_id) attaches the sample's request identity
+        to the bucket it lands in (last-wins per bucket) — the link from a
+        tail-latency number to the distributed trace that produced it,
+        exposed through ``collect()``/JSONL/``/metrics.json``."""
         if not self._registry._enabled:
             return
         value = float(value)
@@ -164,6 +200,11 @@ class Histogram(_Metric):
             series.sum += value
             series.count += 1
             series.samples.append(value)
+            if exemplar is not None:
+                series.exemplars[idx] = {
+                    "value": value, "trace_id": str(exemplar),
+                    "time": time.time(),
+                }
 
     def summary(self, **labels) -> dict:
         """count / sum / mean / p50 / p90 over the (bounded) raw tail."""
@@ -195,6 +236,7 @@ class Registry:
         self._enabled = bool(enabled)
         self._lock = threading.Lock()
         self._metrics: dict[str, _Metric] = {}
+        self._collect_hooks: list = []  # weakrefs, pruned on collect
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -252,8 +294,40 @@ class Registry:
 
     # -- exposition --------------------------------------------------------
 
+    def add_collect_hook(self, fn) -> None:
+        """Register ``fn`` to run at the top of every exposition
+        (``collect``/``to_prometheus_text``). For owners of DERIVED
+        point-in-time gauges (``obs.slo``'s rolling burn-rate/status)
+        whose value depends on the clock, not just on ingest: without a
+        scrape-time refresh, a gauge last exported during a burst would
+        FREEZE at that value once the class's traffic stops — an idle
+        class would page forever. Held by weak reference: the hook dies
+        with its owner (no unregister needed, no cross-test leaks)."""
+        ref = (weakref.WeakMethod(fn) if hasattr(fn, "__self__")
+               else weakref.ref(fn))
+        with self._lock:
+            self._collect_hooks.append(ref)
+
+    def _run_collect_hooks(self) -> None:
+        with self._lock:
+            refs = list(self._collect_hooks)
+        dead = [r for r in refs if r() is None]
+        for r in refs:
+            fn = r()
+            if fn is not None:
+                try:
+                    fn()
+                except Exception:
+                    pass  # a broken refresher must not break exposition
+        if dead:
+            with self._lock:
+                self._collect_hooks = [
+                    r for r in self._collect_hooks if r not in dead
+                ]
+
     def collect(self) -> list[dict]:
         """Point-in-time snapshot: one record per labeled series."""
+        self._run_collect_hooks()
         with self._lock:
             metrics = list(self._metrics.values())
         out = []
@@ -266,12 +340,28 @@ class Registry:
                         running += c
                         cumulative[str(bound)] = running
                     cumulative["+Inf"] = running + series.counts[-1]
-                    out.append({
+                    rec = {
                         "name": m.name, "type": m.kind, "labels": labels,
                         "buckets": cumulative,
                         "sum": series.sum, "count": series.count,
                         "summary": m.summary(**labels),
-                    })
+                    }
+                    if series.exemplars:
+                        # snapshot under the metric lock: observe() inserts
+                        # new bucket keys concurrently, and iterating a
+                        # live dict across a resize raises RuntimeError
+                        # (the unlocked counts/sum reads are torn-read-
+                        # benign; a dict iteration is not)
+                        with m._lock:
+                            ex_items = sorted(series.exemplars.items())
+                        # keyed by bucket BOUND (the exposition's own
+                        # vocabulary), not internal index
+                        rec["exemplars"] = {
+                            ("+Inf" if i == len(m.buckets)
+                             else str(m.buckets[i])): dict(ex)
+                            for i, ex in ex_items
+                        }
+                    out.append(rec)
                 else:
                     out.append({
                         "name": m.name, "type": m.kind, "labels": labels,
@@ -294,6 +384,7 @@ class Registry:
 
     def to_prometheus_text(self) -> str:
         """Prometheus text exposition format 0.0.4."""
+        self._run_collect_hooks()
         with self._lock:
             metrics = list(self._metrics.values())
         lines = []

@@ -182,6 +182,14 @@ _NOT_A_METRIC = (
     # acceptance/window telemetry is workload-dependent
     "pages_at_budget", "page_size", "bit_identical", "_peak_concurrent",
     "capacity_tokens", "windows_used", "accept_rate", "ticks_per_token",
+    # request_tracing section: verdict rows (`_ok` 0/1 flags), burn-rate
+    # status/shares, tail attributions, and the per-class burst-schedule
+    # accounting (requests/goodput/p99-threshold rows — tail stats over a
+    # few dozen scripted requests, SLO accounting not a perf signal) are
+    # never perf-gated; per_request_trace_us stays gated via the
+    # "_trace_us" suffix and the tick walls via the "tick_ms" contains
+    # rule
+    "_ok", "dominant", "_burn", "tracing_interactive_", "tracing_batch_",
     # long_context section: ladder geometry + analytic accounting rows.
     # The KV wire-byte rows are EXACT schedule counts (the generic "_bytes"
     # rule above already exempts them — a changed count is a schedule
@@ -198,14 +206,22 @@ _HIGHER_BETTER = (
     # long_context: the highest sequence rung a train step COMPLETED
     "max_tokens",
 )
-_LOWER_BETTER_SUFFIX = ("_ms", "_s", "_sec", "_pct", "_ppl")
+_LOWER_BETTER_SUFFIX = ("_ms", "_s", "_sec", "_trace_us", "_pct", "_ppl")
+# "_trace_us" (not bare "_us"): gates request_tracing's per-request bill
+# down-good WITHOUT flipping forensics_enabled_bundle_us — a single-shot
+# µs wall sample that was deliberately never gated.
 # "ttft"/"tpot": the serving_fleet section's time-to-first-token and
 # per-token-latency rows gate down-good (their `_ms` suffix already says
 # so; the explicit tokens make the intent survive a unit rename), while
 # `goodput_per_chip`/`tokens_per_sec` ride the up-good table above and
 # `burst_isolation_speedup` the "speedup" rule.
 _LOWER_BETTER_CONTAINS = ("loss", "overhead", "stall", "latency", "ttft",
-                          "tpot")
+                          # "tick_ms": the request_tracing fleet tick
+                          # walls end in _enabled/_disabled, so the _ms
+                          # SUFFIX rule misses them — the enabled-vs-
+                          # disabled A/B is the end-to-end cost this
+                          # section exists to watch
+                          "tpot", "tick_ms")
 
 
 def metric_direction(name: str) -> str | None:

@@ -374,8 +374,16 @@ def run_chaos_serving_fleet(router, prompts, max_new: int,
     router re-prefills on a survivor (prefill is a pure function of the
     prompt, so the regenerated KV rows — and therefore the tokens — are
     identical). Returns results plus the requeue counts the verdict needs
-    to prove the kill actually interrupted work in flight."""
+    to prove the kill actually interrupted work in flight, and the
+    request-tracing verdicts: a killed request's re-run must retire under
+    the SAME trace_id with its retry recorded, and its SLO burn must
+    count the FULL user-visible latency (original submit → final retire,
+    not just the post-requeue leg)."""
     frids = [router.submit(p, max_new) for p in prompts]
+    minted = {
+        f: (router.trace_of(f).trace_id if router.trace_of(f) else None)
+        for f in frids
+    }
     tick = 0
     while router.outstanding:
         kill = kill_ticks.get(tick)
@@ -392,11 +400,39 @@ def run_chaos_serving_fleet(router, prompts, max_new: int,
         if tick > max_ticks:
             raise RuntimeError(f"serving chaos did not drain in {max_ticks}")
     results = router.run(max_ticks=1)  # drains the harvested results
+    # -- tracing verdicts over the requeued set -----------------------------
+    requeue_t: dict[int, float] = {}
+    for f, t in router.requeue_log:
+        requeue_t[f] = t  # last requeue wins (the run that finished)
+    requeued = [f for f in requeue_t if f in set(frids)]
+    records = router.request_records
+    same_trace = all(
+        records.get(f, {}).get("trace_id") == minted.get(f)
+        and minted.get(f) is not None
+        for f in requeued
+    )
+    retry_recorded = all(
+        records.get(f, {}).get("retries", 0) >= 1 for f in requeued
+    )
+    # full-latency burn: the recorded e2e must EXCEED the post-requeue
+    # leg alone — i.e. the clock kept running from the ORIGINAL submit
+    # through the kill, not from the retry
+    burn_full = all(
+        records.get(f, {}).get("e2e_s") is not None
+        and records[f].get("finished_mono") is not None
+        and records[f]["e2e_s"]
+        > (records[f]["finished_mono"] - requeue_t[f]) - 1e-9
+        for f in requeued
+    )
     return {
         "results": {f: results.get(f, []) for f in frids},
         "ticks": tick,
         "requeued_prefill": router.requeued_prefill,
         "requeued_decode": router.requeued_decode,
+        "requeued_requests": len(requeued),
+        "trace_requeue_same": int(same_trace),
+        "trace_retry_recorded": int(retry_recorded),
+        "trace_burn_full_latency": int(burn_full),
     }
 
 
@@ -594,6 +630,10 @@ def _serving_fleet_smoke(model, cfg, rng) -> dict:
         "ticks": out["ticks"],
         "requeued_prefill": out["requeued_prefill"],
         "requeued_decode": out["requeued_decode"],
+        "requeued_requests": out["requeued_requests"],
+        "trace_requeue_same": out["trace_requeue_same"],
+        "trace_retry_recorded": out["trace_retry_recorded"],
+        "trace_burn_full_latency": out["trace_burn_full_latency"],
     }
 
 
@@ -1077,6 +1117,22 @@ def verify(report: dict) -> list[str]:
             bad.append(
                 "serving_fleet: the decode-worker kill interrupted no "
                 "work — the full-pipeline re-run path went unexercised"
+            )
+        if not fleet.get("trace_requeue_same", 1):
+            bad.append(
+                "serving_fleet: a killed request's re-run retired under a "
+                "DIFFERENT trace_id — the retry must stay on the same trace"
+            )
+        if not fleet.get("trace_retry_recorded", 1):
+            bad.append(
+                "serving_fleet: a requeued request retired with zero "
+                "recorded retries — the requeue span went unrecorded"
+            )
+        if not fleet.get("trace_burn_full_latency", 1):
+            bad.append(
+                "serving_fleet: a requeued request's SLO burn counted only "
+                "the post-requeue leg — the budget must pay the FULL "
+                "user-visible latency, kill included"
             )
     paged = report.get("serving_paged")
     if paged is not None:

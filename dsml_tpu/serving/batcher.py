@@ -84,6 +84,21 @@ class Request:
     # to a reference batcher whose rids differ from this replica's (the
     # router stamps its fleet-wide rid here; None = use ``rid``)
     key_rid: int | None = None
+    # request-scoped trace identity (obs.TraceContext.trace_id): stamped
+    # by the router at submit and carried through the handoff wire — the
+    # decode-side spans/flow events and the admission-histogram exemplar
+    # all tag with it, so a tail latency resolves to ONE request's trace
+    trace_id: str | None = None
+
+    def trace_ctx(self):
+        """The request's TraceContext (flow id derives from trace_id
+        alone, so the decode side rebuilds it without extra wire state);
+        None when the request carries no trace."""
+        if self.trace_id is None:
+            return None
+        from dsml_tpu.obs import TraceContext
+
+        return TraceContext(trace_id=self.trace_id)
 
 
 def _bucket(n: int, buckets: tuple) -> int:
@@ -310,6 +325,12 @@ class ContinuousBatcher:
             # everything unallocated; device copy rides along per dispatch
             self._page_table = np.zeros((n_slots, self._n_pt), np.int32)
             self._slot_pages: list[list] = [[] for _ in range(n_slots)]
+            # flow marks dedupe per wait EPISODE (rid of the last blocked
+            # head per queue) — the counter stays per-tick, but marking
+            # every blocked tick would flood a stuck request's trace chain
+            # and churn the bounded span buffer
+            self._page_wait_rid_inject: int | None = None
+            self._page_wait_rid_queue: int | None = None
             self.n_cow_copies = 0
             # pages the prefix registry holds FOREVER — the never-fits
             # checks subtract these from the reservable ceiling (a pool
@@ -805,7 +826,8 @@ class ContinuousBatcher:
     # ---- request interface -----------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int,
-               key_rid: int | None = None) -> int:
+               key_rid: int | None = None,
+               trace_id: str | None = None) -> int:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("empty prompt")
@@ -862,7 +884,8 @@ class ContinuousBatcher:
         rid = self._next_rid
         self._next_rid += 1
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
-                      submitted_at=time.monotonic(), key_rid=key_rid)
+                      submitted_at=time.monotonic(), key_rid=key_rid,
+                      trace_id=trace_id)
         self._queue.append(req)
         self._live[rid] = req
         return rid
@@ -882,7 +905,7 @@ class ContinuousBatcher:
                logits_row=None, key_rid: int | None = None,
                submitted_at: float | None = None, *,
                kv_pages=None, page_size: int | None = None,
-               prefix_rows: int = 0) -> int:
+               prefix_rows: int = 0, trace_id: str | None = None) -> int:
         """Admit a request whose PREFILL already ran elsewhere — the
         decode-worker half of the disaggregated fleet's KV handoff
         (``dsml_tpu.serving.handoff``). ``cache1`` is the 1-row KV cache a
@@ -989,9 +1012,18 @@ class ContinuousBatcher:
             rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
             submitted_at=(time.monotonic() if submitted_at is None
                           else submitted_at),
-            key_rid=key_rid,
+            key_rid=key_rid, trace_id=trace_id,
         )
         self._live[rid] = req
+        ctx = req.trace_ctx()
+        if ctx is not None and self._obs.enabled:
+            from dsml_tpu.obs import get_tracer
+
+            # the handoff landed on this decode worker: a flow step on
+            # the decode lane links the prefill host's handoff span to
+            # the admission that follows
+            get_tracer().flow("decode_inject", ctx, phase="step",
+                              rid=rid, replica=self.obs_replica)
         payload = (kv_pages, int(prefix_rows)) if self.paged else cache1
         self._inject.append((req, payload, np.asarray(logits_row).reshape(-1)))
         return rid
@@ -1032,6 +1064,12 @@ class ContinuousBatcher:
             n_full = prefix_rows // self.page_size
             n_private = pages_for(rows, self.page_size) - n_full
             if not self._pages.can_alloc(n_private):
+                from dsml_tpu.serving.paging import note_page_wait
+
+                first = self._page_wait_rid_inject != req.rid
+                self._page_wait_rid_inject = req.rid
+                note_page_wait(self._obs, self.obs_replica, self.obs_role,
+                               trace=req.trace_ctx() if first else None)
                 return  # pool full: the handoff waits for retirements
             shared = (self._registered_prefix_pages(req.prompt, prefix_rows)
                       if prefix_rows else [])
@@ -1315,19 +1353,29 @@ class ContinuousBatcher:
         req.first_token_at = time.monotonic()
         if self._obs.enabled:
             # admission latency = queue wait + prefill: the serving-side
-            # TTFT, as a histogram the /metrics endpoint can expose live
+            # TTFT, as a histogram the /metrics endpoint can expose live.
+            # The sample carries the request's trace_id as an EXEMPLAR, so
+            # a tail bucket resolves to the trace that landed in it
             admission_ms = (req.first_token_at - req.submitted_at) * 1e3
             self._obs.histogram(
                 "serving_admission_ms", "submit→first-token latency",
                 labels=("replica", "role"),
-            ).observe(admission_ms, replica=self.obs_replica,
-                      role=self.obs_role)
-            from dsml_tpu.obs import flight_recorder
+            ).observe(admission_ms, exemplar=req.trace_id,
+                      replica=self.obs_replica, role=self.obs_role)
+            from dsml_tpu.obs import flight_recorder, get_tracer
 
+            extra = {"trace_id": req.trace_id} if req.trace_id else {}
             flight_recorder.record(
                 "serving_admit", rid=req.rid, prompt_len=len(req.prompt),
-                admission_ms=round(admission_ms, 3),
+                admission_ms=round(admission_ms, 3), **extra,
             )
+            ctx = req.trace_ctx()
+            if ctx is not None:
+                get_tracer().instant(
+                    "serving_first_token", trace_id=req.trace_id,
+                    rid=req.rid, admission_ms=round(admission_ms, 3),
+                    replica=self.obs_replica,
+                )
         emitted[req.rid] = [tok]
         if self._finished(req, tok):
             self._retire(req)
@@ -1483,6 +1531,12 @@ class ContinuousBatcher:
                         "registry); register prefixes before accepting "
                         "traffic, or raise n_pages"
                     )
+                from dsml_tpu.serving.paging import note_page_wait
+
+                first = self._page_wait_rid_queue != req.rid
+                self._page_wait_rid_queue = req.rid
+                note_page_wait(self._obs, self.obs_replica, self.obs_role,
+                               trace=req.trace_ctx() if first else None)
                 return emitted  # pool full: wait for retirements
             self._queue.popleft()
             self._assign_slot_pages(slot, plan)
@@ -1533,14 +1587,23 @@ class ContinuousBatcher:
             req.finished_at - req.submitted_at,  # e2e
         ))
         if self._obs.enabled:
-            from dsml_tpu.obs import flight_recorder
+            from dsml_tpu.obs import flight_recorder, get_tracer
 
             # per-request lifecycle in the flight ring: a serving postmortem
             # shows which requests were in flight and their tail latencies
+            extra = {"trace_id": req.trace_id} if req.trace_id else {}
             flight_recorder.record(
                 "serving_retire", rid=req.rid, tokens=len(req.tokens),
                 e2e_ms=round((req.finished_at - req.submitted_at) * 1e3, 3),
+                **extra,
             )
+            ctx = req.trace_ctx()
+            if ctx is not None:
+                # flow END: the request's causal chain terminates on this
+                # decode worker's lane (retire is the one stage that knows)
+                get_tracer().flow("serving_retire", ctx, phase="end",
+                                  rid=req.rid, outcome="retired",
+                                  replica=self.obs_replica)
         # move out of the live table so a long-running server doesn't
         # accumulate one Request per lifetime request; collect() drains
         self._done[req.rid] = self._live.pop(req.rid)
@@ -1598,7 +1661,18 @@ class ContinuousBatcher:
         tokens this tick — including each admission's prefill-sampled first
         token (a request finishing mid-quantum gets its truncated tail; the
         over-decoded lane-ticks are the quantum's scheduling cost)."""
-        emitted = self._step_inner()
+        if not self._obs.enabled:
+            emitted = self._step_inner()
+            self._note_emissions(emitted)
+            return emitted
+        from dsml_tpu.obs import get_tracer
+
+        # one span per scheduler tick (decode quantum + admissions): the
+        # decode leg of request tracing — a request's inter-token stalls
+        # land inside these spans on the worker's own timeline lane
+        with get_tracer().span("decode_tick", replica=self.obs_replica,
+                               n_active=self.n_active):
+            emitted = self._step_inner()
         self._note_emissions(emitted)
         if self._obs.enabled:
             # batch occupancy per tick: the utilization signal behind
@@ -1931,9 +2005,19 @@ class ContinuousBatcher:
             for slot in range(self.n_slots):
                 self._free_slot_pages(slot)
         if self._obs.enabled:
-            from dsml_tpu.obs import flight_recorder
+            from dsml_tpu.obs import flight_recorder, get_tracer
 
             flight_recorder.record("serving_abandon", n_requests=len(live))
+            tracer = get_tracer()
+            for req in live:
+                if req.trace_id is not None:
+                    # NOT a flow end: the router requeues these under the
+                    # SAME trace — the chain continues on a survivor
+                    tracer.instant(
+                        "serving_abandon", trace_id=req.trace_id,
+                        rid=req.rid, outcome="abandoned",
+                        replica=self.obs_replica,
+                    )
         return live
 
     def collect(self) -> dict[int, list]:

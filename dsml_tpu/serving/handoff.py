@@ -88,6 +88,13 @@ class Handoff:
     # for them (the fleet-level CoW elision; always a page multiple).
     page_size: int | None = None
     prefix_rows: int = 0
+    # request-scoped trace identity (obs.TraceContext): minted at
+    # Router.submit, stamped by the prefill worker, carried through BOTH
+    # wire paths (codec header + donor descriptor header) so the decode
+    # host's spans join the same causal chain. ``parent_span`` names the
+    # emitting stage's span — the cross-process parent link.
+    trace_id: str | None = None
+    parent_span: str = ""
 
 
 def _leaves(cache1) -> list:
@@ -139,6 +146,8 @@ def encode_handoff(handoff: Handoff) -> dict:
         "n_layers": len(handoff.cache1),
         "page_size": handoff.page_size,
         "prefix_rows": int(handoff.prefix_rows),
+        "trace_id": handoff.trace_id,
+        "parent_span": handoff.parent_span,
         "leaves": leaves,
         "logits_nbytes": len(parts[-1]),
         "total_nbytes": len(payload),
@@ -197,6 +206,8 @@ def decode_handoff(frame: dict, validate: bool = True) -> Handoff:
         key_rid=header.get("key_rid"),
         page_size=header.get("page_size"),
         prefix_rows=int(header.get("prefix_rows", 0)),
+        trace_id=header.get("trace_id"),
+        parent_span=str(header.get("parent_span") or ""),
     )
 
 
@@ -231,14 +242,16 @@ def register_with_donor(donor, handoff: Handoff, prefix: str | None = None) -> d
     leaves, total = [], 0
     for i, key, arr in _leaves(handoff.cache1):
         a = _host(arr)
-        donor.register_array(f"{prefix}/{i}/{key}", a)
+        donor.register_array(f"{prefix}/{i}/{key}", a,
+                             trace_id=handoff.trace_id)
         leaves.append({
             "layer": i, "entry": key, "dtype": str(a.dtype),
             "shape": list(a.shape), "nbytes": int(a.nbytes),
         })
         total += int(a.nbytes)
     logits = _host(handoff.logits).astype(np.float32, copy=False)
-    donor.register_array(f"{prefix}/logits", logits)
+    donor.register_array(f"{prefix}/logits", logits,
+                         trace_id=handoff.trace_id)
     header = {
         "schema": HANDOFF_SCHEMA,
         "frid": int(handoff.frid),
@@ -251,6 +264,8 @@ def register_with_donor(donor, handoff: Handoff, prefix: str | None = None) -> d
         "n_layers": len(handoff.cache1),
         "page_size": handoff.page_size,
         "prefix_rows": int(handoff.prefix_rows),
+        "trace_id": handoff.trace_id,
+        "parent_span": handoff.parent_span,
         "leaves": leaves,
         "logits_nbytes": int(logits.nbytes),
         "total_nbytes": total + int(logits.nbytes),
@@ -265,19 +280,31 @@ def fetch_from_migrator(migrator, descriptor: dict) -> Handoff:
     donor-death retries — the exact machinery elastic shard migration
     rides. Raises ``comm.migration.MigrationError`` when a leaf cannot be
     delivered; the router's contract is then re-prefill on a survivor."""
+    from dsml_tpu.obs import TraceContext, get_tracer
+
     header = descriptor["header"]
     prefix = descriptor["prefix"]
+    ctx = TraceContext.from_header(header)
     cache1: list = [{} for _ in range(int(header["n_layers"]))]
-    for leaf in header["leaves"]:
-        piece = [[0, int(s)] for s in leaf["shape"]]
-        arr = migrator.fetch_piece(
-            f"{prefix}/{leaf['layer']}/{leaf['entry']}", piece, leaf["dtype"]
-        )
-        cache1[int(leaf["layer"])][leaf["entry"]] = arr
-    vocab = int(header["logits_nbytes"]) // np.dtype(np.float32).itemsize
-    logits = migrator.fetch_piece(
-        f"{prefix}/logits", [[0, vocab]], "float32"
-    ).reshape(-1)
+    # the pull is the cross-host hop — a trace-tagged span (+ flow step)
+    # on the DECODE host's timeline, so the stitched view shows the wire
+    # time between the prefill host's handoff span and decode admission
+    with get_tracer().request_span(
+        "handoff_pull", ctx, flow="step", frid=int(header["frid"]),
+        nbytes=int(header["total_nbytes"]),
+    ):
+        for leaf in header["leaves"]:
+            piece = [[0, int(s)] for s in leaf["shape"]]
+            arr = migrator.fetch_piece(
+                f"{prefix}/{leaf['layer']}/{leaf['entry']}", piece,
+                leaf["dtype"], trace_id=header.get("trace_id"),
+            )
+            cache1[int(leaf["layer"])][leaf["entry"]] = arr
+        vocab = int(header["logits_nbytes"]) // np.dtype(np.float32).itemsize
+        logits = migrator.fetch_piece(
+            f"{prefix}/logits", [[0, vocab]], "float32",
+            trace_id=header.get("trace_id"),
+        ).reshape(-1)
     return Handoff(
         frid=int(header["frid"]),
         prompt=np.asarray(header["prompt"], np.int32),
@@ -290,4 +317,6 @@ def fetch_from_migrator(migrator, descriptor: dict) -> Handoff:
         key_rid=header.get("key_rid"),
         page_size=header.get("page_size"),
         prefix_rows=int(header.get("prefix_rows", 0)),
+        trace_id=header.get("trace_id"),
+        parent_span=str(header.get("parent_span") or ""),
     )

@@ -45,6 +45,7 @@ __all__ = [
     "copy_page",
     "prefill_prefix_into_pages",
     "export_pool_gauges",
+    "note_page_wait",
 ]
 
 
@@ -232,3 +233,26 @@ def export_pool_gauges(obs, pool: PagePool, replica: str, role: str) -> None:
         obs.gauge(name, help_, labels=("replica", "role")).set(
             value, replica=replica, role=role
         )
+
+
+def note_page_wait(obs, replica: str, role: str, trace=None) -> None:
+    """Count one pool-pressure wait tick (an admission that could not
+    reserve its page plan and stayed queued) and, when ``trace`` is
+    passed, mark the wait on the request's flow — page-pool pressure is
+    a real TTFT stage and must be attributable per request, not just
+    visible as a gauge dip (docs/OBSERVABILITY.md § Request tracing &
+    SLO budgets). Callers pass ``trace`` only on the FIRST blocked tick
+    of a wait episode: a request stuck for thousands of ticks must not
+    flood its causal chain with arrows or churn the bounded span buffer
+    out of the events a postmortem needs (the counter stays per-tick)."""
+    if not obs.enabled:
+        return
+    obs.counter(
+        "serving_page_wait_total",
+        "admission ticks spent waiting for pool pages",
+        labels=("replica", "role"),
+    ).inc(replica=replica, role=role)
+    if trace is not None:
+        from dsml_tpu.obs import get_tracer
+
+        get_tracer().flow("page_wait", trace, phase="step", replica=replica)

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dsml_tpu.obs import get_registry
+from dsml_tpu.obs import get_registry, get_tracer
 from dsml_tpu.serving.batcher import QueueFull
 from dsml_tpu.serving.handoff import Handoff
 
@@ -46,6 +46,10 @@ class _Job:
     # prefix subtracted at submit time) — summed into the worker's O(1)
     # running load counter; re-stamped when a new prefix registers
     eff_tokens: int = 0
+    # request trace context (obs.TraceContext or None): every chunk span
+    # and the emitted handoff carry it — the prefill leg of the request's
+    # cross-process causal chain
+    trace: object = None
 
 
 class PrefillWorker:
@@ -90,6 +94,10 @@ class PrefillWorker:
         self._pending: tuple | None = None
         self._prefixes: list = []  # (tokens, cache1|pages, last_logits) len-desc
         self._next_frid = 0
+        # page-wait flow marks dedupe per wait EPISODE (frid of the last
+        # blocked queue head): the counter is per-tick, the trace mark is
+        # once per episode
+        self._page_wait_frid: int | None = None
         # measured per-chunk wall EWMA (seconds) — the router's prefill
         # cost model; seeded by the first real chunk
         self.chunk_s_ewma: float | None = None
@@ -158,7 +166,7 @@ class PrefillWorker:
 
     def submit(self, prompt, max_new_tokens: int, frid: int | None = None,
                key_rid: int | None = None,
-               submitted_at: float | None = None) -> int:
+               submitted_at: float | None = None, trace=None) -> int:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("empty prompt")
@@ -208,6 +216,7 @@ class PrefillWorker:
             submitted_at=(time.monotonic() if submitted_at is None
                           else submitted_at),
             eff_tokens=eff,
+            trace=trace,
         ))
         self._queued_tokens += eff
         return frid
@@ -328,6 +337,7 @@ class PrefillWorker:
         straddling prefix page always ships — the suffix wrote into it."""
         n_skip = n_full_prefix if self.ship_prefix_pages else 0
         self.n_handoffs += 1
+        self._note_handoff(job)
         return Handoff(
             frid=job.frid, prompt=job.prompt,
             max_new_tokens=job.max_new_tokens,
@@ -339,7 +349,16 @@ class PrefillWorker:
             key_rid=job.key_rid,
             page_size=self.page_size,
             prefix_rows=n_skip * self.page_size,
+            trace_id=(job.trace.trace_id if job.trace else None),
+            parent_span="prefill_chunk",
         )
+
+    def _note_handoff(self, job: _Job) -> None:
+        """Trace the handoff emission: a flow STEP on this worker's lane
+        (the prefill→decode hop the stitched timeline links through)."""
+        if job.trace is not None:
+            get_tracer().flow("prefill_handoff", job.trace, phase="step",
+                              frid=job.frid, replica=self.obs_replica)
 
     def _start(self, job: _Job):
         """Begin ``job``: an exact prefix hit completes immediately (COPIED
@@ -393,6 +412,7 @@ class PrefillWorker:
             ptoks, pcache, plogits = pre
             if len(ptoks) == len(job.prompt):
                 self.n_handoffs += 1
+                self._note_handoff(job)
                 return Handoff(
                     frid=job.frid, prompt=job.prompt,
                     max_new_tokens=job.max_new_tokens,
@@ -402,6 +422,8 @@ class PrefillWorker:
                     submitted_at=job.submitted_at,
                     prefill_done_at=time.monotonic(),
                     key_rid=job.key_rid,
+                    trace_id=(job.trace.trace_id if job.trace else None),
+                    parent_span="prefix_hit",
                 )
             self._pending = (job, jax.tree.map(jnp.copy, pcache), len(ptoks))
             return None
@@ -424,20 +446,27 @@ class PrefillWorker:
         is_last = end >= L
         last_local = (L - 1) - start if is_last else c - 1
         t0 = time.monotonic()
-        if self.paged:
-            plan = state
-            table = np.zeros((1, self._n_pt), np.int32)
-            table[0, : len(plan.pages)] = plan.pages
-            logits, self._pool = self._chunk_paged(
-                self.params, self._pool, jnp.asarray(table),
-                jnp.asarray(padded), jnp.int32(start), jnp.int32(last_local),
-            )
-        else:
-            logits, state = self._chunk(
-                self.params, state, jnp.asarray(padded),
-                jnp.int32(start), jnp.int32(last_local),
-            )
-        logits_host = np.asarray(logits[0])  # forces the dispatch to finish
+        # one span per chunk dispatch, tagged with the request's trace —
+        # the prefill leg a p99 TTFT outlier resolves to on the timeline
+        with get_tracer().request_span(
+            "prefill_chunk", job.trace, frid=job.frid, start=int(start),
+            replica=self.obs_replica,
+        ):
+            if self.paged:
+                plan = state
+                table = np.zeros((1, self._n_pt), np.int32)
+                table[0, : len(plan.pages)] = plan.pages
+                logits, self._pool = self._chunk_paged(
+                    self.params, self._pool, jnp.asarray(table),
+                    jnp.asarray(padded), jnp.int32(start),
+                    jnp.int32(last_local),
+                )
+            else:
+                logits, state = self._chunk(
+                    self.params, state, jnp.asarray(padded),
+                    jnp.int32(start), jnp.int32(last_local),
+                )
+            logits_host = np.asarray(logits[0])  # forces the dispatch
         wall = time.monotonic() - t0
         self.n_chunk_dispatches += 1
         self.chunk_s_ewma = (
@@ -460,6 +489,7 @@ class PrefillWorker:
             self._pages.release(plan.pages)
             return h
         self.n_handoffs += 1
+        self._note_handoff(job)
         return Handoff(
             frid=job.frid, prompt=job.prompt,
             max_new_tokens=job.max_new_tokens, prefill_len=L,
@@ -467,6 +497,8 @@ class PrefillWorker:
             submitted_at=job.submitted_at,
             prefill_done_at=time.monotonic(),
             key_rid=job.key_rid,
+            trace_id=(job.trace.trace_id if job.trace else None),
+            parent_span="prefill_chunk",
         )
 
     def step(self) -> list[Handoff]:
@@ -482,6 +514,13 @@ class PrefillWorker:
                 #                       reserve pages keeps its queue spot
                 h = self._start(job)
                 if h == "wait":
+                    from dsml_tpu.serving.paging import note_page_wait
+
+                    first = self._page_wait_frid != job.frid
+                    self._page_wait_frid = job.frid
+                    note_page_wait(self._obs, self.obs_replica,
+                                   self.obs_role,
+                                   trace=job.trace if first else None)
                     break
                 self._queue.popleft()
                 self._queued_tokens -= job.eff_tokens

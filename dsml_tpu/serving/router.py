@@ -26,6 +26,14 @@ decode workers behind one admission surface. Responsibilities:
   survivors. Prefill is a pure function of the prompt and the sampler
   folds the fleet-wide rid, so a worker loss costs latency, never tokens
   (``runtime.chaos.run_chaos_serving_fleet`` pins it).
+- **Request tracing + SLO accounting** — ``submit`` mints a
+  :class:`~dsml_tpu.obs.TraceContext` that rides every stage (prefill
+  dispatch, the handoff wire, decode injection, retire/requeue — the
+  SAME trace across retries), the TTFT/TPOT histograms carry trace_id
+  exemplars, and each class's measured TTFT/TPOT/e2e feeds
+  ``obs/slo.py`` SLI windows → burn-rate status + p99 tail attribution
+  (``Router.slo``; docs/OBSERVABILITY.md § Request tracing & SLO
+  budgets).
 """
 
 from __future__ import annotations
@@ -36,27 +44,46 @@ from collections import deque
 
 import numpy as np
 
-from dsml_tpu.obs import flight_recorder, get_registry
+from dsml_tpu.obs import TraceContext, flight_recorder, get_registry, get_tracer
+from dsml_tpu.obs.slo import SLOSpec, SLOTracker
 from dsml_tpu.serving.batcher import ContinuousBatcher, QueueFull
 from dsml_tpu.serving.prefill import PrefillWorker
+from dsml_tpu.utils.config import env_int
 from dsml_tpu.utils.logging import get_logger
 
 __all__ = ["Router", "SLOClass", "build_fleet"]
 
 log = get_logger("serving.router")
 
+# raw per-request sample/record retention (offline percentiles, SLO tail
+# attribution, chaos verdicts): bounded so a long-lived fleet's host
+# memory stays flat — overflow counts into ``dropped_samples`` +
+# ``serving_samples_dropped_total`` instead of growing silently
+_SAMPLE_CAP_ENV = "DSML_SERVING_SAMPLES"
+_SAMPLE_CAP_DEFAULT = 4096
+
 
 @dataclasses.dataclass(frozen=True)
 class SLOClass:
     """One admission class. ``max_queue`` caps this class's ROUTER backlog
     (0 = unbounded); ``ttft_budget_ms`` sheds when the measured-load TTFT
-    estimate exceeds it (None = no budget); lower ``priority`` dispatches
-    first when classes compete for prefill capacity."""
+    estimate exceeds it (None = no budget) AND doubles as the class's
+    measured TTFT SLI budget; lower ``priority`` dispatches first when
+    classes compete for prefill capacity.
+
+    The SLO-accounting fields (``obs/slo.py``): ``tpot_budget_ms`` /
+    ``e2e_budget_ms`` budget the other two SLIs, ``objective`` is the
+    target good fraction each budgeted SLI must meet before its error
+    budget starts burning (docs/OBSERVABILITY.md § Request tracing &
+    SLO budgets)."""
 
     name: str
     max_queue: int = 0
     ttft_budget_ms: float | None = None
     priority: int = 0
+    tpot_budget_ms: float | None = None
+    e2e_budget_ms: float | None = None
+    objective: float = 0.99
 
 
 @dataclasses.dataclass
@@ -65,6 +92,7 @@ class _Spec:
     max_new_tokens: int
     slo: str
     submitted_at: float
+    trace: TraceContext | None = None
 
 
 class Router:
@@ -132,14 +160,38 @@ class Router:
         self.decode_wait_ewma_s: float | None = None
         # raw per-request samples (ttft_s, tpot_s or None, e2e_s) for
         # offline percentiles — the bench/SLO-report path; cleared by
-        # :meth:`reset_latency_stats`
-        self.latency_samples: list[tuple] = []
+        # :meth:`reset_latency_stats`. BOUNDED (maxlen deque): a
+        # long-lived fleet must not grow host memory one tuple per
+        # lifetime request — overflow is counted, never silent
+        self._sample_cap = max(env_int(_SAMPLE_CAP_ENV, _SAMPLE_CAP_DEFAULT), 1)
+        self.latency_samples: deque[tuple] = deque(maxlen=self._sample_cap)
+        self.dropped_samples = 0
         self._tpot_by_worker: dict[int, float] = {}
         self.shed_counts: dict[str, int] = {c.name: 0 for c in classes}
         self.requeued_prefill = 0
         self.requeued_decode = 0
         self.transport_failures = 0
         self.n_handoffs_routed = 0
+        # ---- request tracing + SLO accounting (the PR 13 layer) ----
+        # trace context per in-flight request; stage marks (monotonic
+        # seconds) split TTFT into queue/prefill/handoff/first-decode;
+        # request_records is the bounded retired-request ledger the chaos
+        # verdicts and the tail-attribution bench read
+        self._trace: dict[int, TraceContext] = {}
+        self._stage_marks: dict[int, dict] = {}
+        self._retries: dict[int, int] = {}
+        self.requeue_log: list[tuple] = []  # (frid, monotonic) — bounded below
+        self.request_records: dict[int, dict] = {}
+        self._record_order: deque[int] = deque()
+        self.slo = SLOTracker([
+            SLOSpec(
+                name=c.name, objective=c.objective,
+                ttft_budget_ms=c.ttft_budget_ms,
+                tpot_budget_ms=c.tpot_budget_ms,
+                e2e_budget_ms=c.e2e_budget_ms,
+            )
+            for c in classes
+        ], registry=self._obs)
 
     # ---- admission -------------------------------------------------------
 
@@ -237,16 +289,34 @@ class Router:
                 )
         frid = self._next_frid
         self._next_frid += 1
-        self._spec[frid] = _Spec(
-            prompt=prompt, max_new_tokens=int(max_new_tokens), slo=cls.name,
-            submitted_at=time.monotonic(),
-        )
-        self._backlog[cls.name].append(frid)
+        # mint the request's trace identity HERE — the fleet edge is the
+        # one point every request passes exactly once. The context then
+        # rides prefill dispatch, the handoff wire, and decode injection;
+        # a requeue keeps the SAME trace (the retry is the same request)
+        ctx = TraceContext.mint(span_id="router_submit")
+        self._trace[frid] = ctx
+        self._retries[frid] = 0
+        with get_tracer().request_span(
+            "router_submit", ctx, flow="start", frid=frid, slo=cls.name,
+            prompt_len=len(prompt),
+        ):
+            self._spec[frid] = _Spec(
+                prompt=prompt, max_new_tokens=int(max_new_tokens),
+                slo=cls.name, submitted_at=time.monotonic(), trace=ctx,
+            )
+            self._stage_marks[frid] = {}
+            self._backlog[cls.name].append(frid)
         return frid
 
     @property
     def outstanding(self) -> int:
         return len(self._spec)
+
+    def trace_of(self, frid: int) -> TraceContext | None:
+        """The trace context minted for ``frid`` at submit (None once the
+        request retired — its trace_id then lives in
+        ``request_records[frid]``)."""
+        return self._trace.get(frid)
 
     # ---- dispatch --------------------------------------------------------
 
@@ -277,6 +347,14 @@ class Router:
                 pw.submit(
                     spec.prompt, spec.max_new_tokens, frid=frid,
                     key_rid=frid, submitted_at=spec.submitted_at,
+                    trace=(spec.trace.child("prefill_dispatch")
+                           if spec.trace else None),
+                )
+                # queue stage ends here: the LAST dispatch wins after a
+                # requeue, so a retry's stage split reflects the run that
+                # actually finished (e2e always counts from first submit)
+                self._stage_marks.setdefault(frid, {})["dispatched"] = (
+                    time.monotonic()
                 )
                 backlog.popleft()
                 self._prefill_at[frid] = pw
@@ -317,16 +395,20 @@ class Router:
                     h.prompt, h.max_new_tokens, logits_row=h.logits,
                     key_rid=h.key_rid, submitted_at=h.submitted_at,
                     kv_pages=h.cache1, page_size=h.page_size,
-                    prefix_rows=h.prefix_rows,
+                    prefix_rows=h.prefix_rows, trace_id=h.trace_id,
                 )
             else:
                 lrid = dw.inject(
                     h.prompt, h.max_new_tokens, h.cache1, h.logits,
                     key_rid=h.key_rid, submitted_at=h.submitted_at,
+                    trace_id=h.trace_id,
                 )
             self._local[(id(dw), lrid)] = h.frid
             self._decode_at[h.frid] = (dw, lrid)
             self._prefill_done_at[h.frid] = h.prefill_done_at
+            marks = self._stage_marks.setdefault(h.frid, {})
+            marks["prefill_done"] = h.prefill_done_at
+            marks["injected"] = time.monotonic()
             self.n_handoffs_routed += 1
             return True
         return False
@@ -337,9 +419,12 @@ class Router:
             if frid is None:
                 continue
             self._decode_at.pop(frid, None)
-            self._spec.pop(frid, None)
+            spec = self._spec.pop(frid, None)
             self._results[frid] = req.tokens
             done_at = self._prefill_done_at.pop(frid, None)
+            ctx = self._trace.pop(frid, None)
+            marks = self._stage_marks.pop(frid, {})
+            retries = self._retries.pop(frid, 0)
             if req.first_token_at is None:
                 continue
             ttft = req.first_token_at - req.submitted_at
@@ -348,14 +433,22 @@ class Router:
                 else 0.8 * self.ttft_ewma_s + 0.2 * ttft
             )
             tpot = None
+            e2e = None
             if len(req.tokens) > 1 and req.finished_at is not None:
                 tpot = (req.finished_at - req.first_token_at) / (
                     len(req.tokens) - 1
                 )
             if req.finished_at is not None:
-                self.latency_samples.append(
-                    (ttft, tpot, req.finished_at - req.submitted_at)
-                )
+                e2e = req.finished_at - req.submitted_at
+                if len(self.latency_samples) == self._sample_cap:
+                    self.dropped_samples += 1
+                    if self._obs.enabled:
+                        self._obs.counter(
+                            "serving_samples_dropped_total",
+                            "per-request samples evicted by the bounded "
+                            "buffer", labels=("replica", "role"),
+                        ).inc(replica=self.obs_replica, role=self.obs_role)
+                self.latency_samples.append((ttft, tpot, e2e))
             if done_at is not None:
                 wait = max(req.first_token_at - done_at, 0.0)
                 self.decode_wait_ewma_s = (
@@ -375,14 +468,66 @@ class Router:
                     self._obs.histogram(
                         "serving_tpot_ms", "per-token decode latency",
                         labels=("replica", "role"),
-                    ).observe(tpot * 1e3, replica=dw.obs_replica,
-                              role=dw.obs_role)
+                    ).observe(tpot * 1e3,
+                              exemplar=ctx.trace_id if ctx else None,
+                              replica=dw.obs_replica, role=dw.obs_role)
             if self._obs.enabled:
                 self._obs.histogram(
                     "serving_ttft_ms", "end-to-end time to first token",
                     labels=("replica", "role"),
-                ).observe(ttft * 1e3, replica=self.obs_replica,
-                          role=self.obs_role)
+                ).observe(ttft * 1e3,
+                          exemplar=ctx.trace_id if ctx else None,
+                          replica=self.obs_replica, role=self.obs_role)
+            self._account_retired(frid, req, spec, ctx, marks, retries,
+                                  ttft, tpot, e2e)
+
+    def _account_retired(self, frid, req, spec, ctx, marks, retries,
+                         ttft, tpot, e2e) -> None:
+        """SLO + stage accounting for one retired request: split TTFT into
+        queue / prefill / handoff / first-decode from the stage marks,
+        feed the class's SLI windows (``obs/slo.py``), and append the
+        bounded request record the chaos verdicts and tail-attribution
+        report read."""
+        slo_name = spec.slo if spec is not None else "default"
+        stages = {}
+        t_sub = req.submitted_at
+        dispatched = marks.get("dispatched")
+        prefill_done = marks.get("prefill_done")
+        injected = marks.get("injected")
+        if dispatched is not None:
+            stages["queue"] = max(dispatched - t_sub, 0.0)
+        if prefill_done is not None and dispatched is not None:
+            stages["prefill"] = max(prefill_done - dispatched, 0.0)
+        if injected is not None and prefill_done is not None:
+            stages["handoff"] = max(injected - prefill_done, 0.0)
+        if injected is not None and req.first_token_at is not None:
+            stages["first_decode"] = max(req.first_token_at - injected, 0.0)
+        if req.finished_at is not None and req.first_token_at is not None:
+            stages["decode"] = req.finished_at - req.first_token_at
+        if slo_name in self.slo.specs:
+            self.slo.record(
+                slo_name,
+                ttft_ms=ttft * 1e3,
+                tpot_ms=None if tpot is None else tpot * 1e3,
+                e2e_ms=None if e2e is None else e2e * 1e3,
+                trace_id=ctx.trace_id if ctx else None,
+                stages=stages,
+            )
+        record = {
+            "frid": frid,
+            "slo": slo_name,
+            "trace_id": ctx.trace_id if ctx else None,
+            "retries": retries,
+            "ttft_s": ttft,
+            "tpot_s": tpot,
+            "e2e_s": e2e,
+            "finished_mono": req.finished_at,
+            "stages_s": stages,
+        }
+        self.request_records[frid] = record
+        self._record_order.append(frid)
+        while len(self._record_order) > self._sample_cap:
+            self.request_records.pop(self._record_order.popleft(), None)
 
     def tick(self) -> None:
         """One fleet pass: retry waiting handoffs → dispatch backlog →
@@ -450,6 +595,13 @@ class Router:
         for dw in self.decode_workers:
             dw.reset_latency_stats()
 
+    def reset_request_records(self) -> None:
+        """Drop the retired-request ledger (and its eviction order —
+        clearing only the dict would desync the bound). Warm-up
+        isolation alongside :meth:`reset_latency_stats` + ``slo.reset()``."""
+        self.request_records.clear()
+        self._record_order.clear()
+
     def run(self, max_ticks: int = 100_000) -> dict[int, list]:
         """Drain everything; returns {frid: [tokens]} for every request
         finished during (or before) this call."""
@@ -470,6 +622,24 @@ class Router:
         if spec is None:
             return
         self._backlog[spec.slo].appendleft(frid)  # it has waited longest
+        # the retry keeps the SAME trace (same request, same error-budget
+        # clock: submitted_at is untouched, so the eventual SLI burn
+        # counts the FULL user-visible latency, kill included) — the
+        # retry span marks the requeue on the request's causal chain
+        self._retries[frid] = self._retries.get(frid, 0) + 1
+        now = time.monotonic()
+        self.requeue_log.append((frid, now))
+        if len(self.requeue_log) > self._sample_cap:
+            del self.requeue_log[: len(self.requeue_log) - self._sample_cap]
+        ctx = self._trace.get(frid)
+        if ctx is not None and self._obs.enabled:
+            tracer = get_tracer()
+            with tracer.request_span(
+                "serving_request_retry", ctx, frid=frid,
+                outcome="requeued", retries=self._retries[frid],
+            ):
+                tracer.flow("serving_request_retry", ctx, phase="step",
+                            outcome="requeued")
 
     def kill_prefill_worker(self, idx: int | None = None) -> int:
         """Chaos hook: drop a prefill worker (default: the last). Its

@@ -46,6 +46,10 @@ SECTIONS = [
     #                          monolithic pool (virtual-8 CPU subprocess;
     #                          burst-isolation + throughput-parity verdicts
     #                          are the signal)
+    ("request_tracing", 600),  # per-request tracing bill vs a decode tick
+    #                            + SLO burn/tail-attribution/exemplar
+    #                            verdicts (virtual-8 CPU subprocess; the
+    #                            verdicts are the signal)
     ("paged_kv", 900),  # paged int4 KV cache vs dense at equal HBM
     #                     (virtual-8 CPU subprocess; capacity-ratio +
     #                     bit-identity verdicts are the signal)

@@ -103,6 +103,21 @@ def test_truncated_trailing_number_is_rejected():
     ("mnist_batch", None),
     ("reference_samples_per_sec", None),
     ("gpt2_seq32k_remat", None),
+    # request_tracing: the per-request bill + tick walls gate down-good;
+    # verdict flags, burn status, tail attribution, and the per-class
+    # burst-schedule accounting (incl. its p99 thresholds) never gate
+    ("request_tracing_per_request_trace_us", "lower"),
+    ("request_tracing_trace_overhead_pct", "lower"),
+    ("request_tracing_decode_tick_ms", "lower"),
+    ("request_tracing_tick_ms_enabled", "lower"),
+    ("request_tracing_tick_ms_disabled", "lower"),
+    ("request_tracing_ttft_exemplar_ok", None),
+    ("request_tracing_interactive_burn_status", None),
+    ("request_tracing_interactive_dominant_stage", None),
+    ("request_tracing_interactive_p99_ms", None),
+    ("request_tracing_batch_goodput_requests", None),
+    # "_trace_us" is scoped so forensics' single-shot µs row stays ungated
+    ("forensics_enabled_bundle_us", None),
 ])
 def test_direction_table(name, want):
     assert metric_direction(name) == want

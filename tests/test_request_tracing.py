@@ -1,0 +1,601 @@
+"""Request-scoped tracing + SLO error-budget accounting (ISSUE 13).
+
+The contract: a TraceContext minted at ``Router.submit`` survives every
+stage a request touches (prefill dispatch, the handoff wire, decode
+injection, retire/requeue) so one request renders as a causal chain
+across process lanes in the stitched Chrome timeline; tail-bucket
+histogram samples carry trace_id exemplars; and per-SLOClass SLI windows
+drive multi-window burn-rate status with the window/burn math pinned
+against numpy.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+from dsml_tpu.obs import TraceContext
+from dsml_tpu.obs.registry import Registry
+from dsml_tpu.obs.slo import (
+    SLOSpec,
+    SLOTracker,
+    burn_rate,
+    status_from_burn,
+    tail_attribution,
+    window_compliance,
+)
+from dsml_tpu.obs.spans import SpanTracer
+from dsml_tpu.serving import ContinuousBatcher, SLOClass, build_fleet
+
+
+def _tiny():
+    cfg = GPT2Config.tiny()
+    return GPT2(cfg), cfg
+
+
+def _prompts(cfg, lengths, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, cfg.vocab_size, (l,)).astype(np.int32)
+            for l in lengths]
+
+
+# ---------------------------------------------------------------------------
+# TraceContext
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_mint_unique_and_header_round_trip():
+    ctxs = [TraceContext.mint() for _ in range(512)]
+    assert len({c.trace_id for c in ctxs}) == 512
+    ctx = ctxs[0]
+    back = TraceContext.from_header(ctx.to_header())
+    assert back == ctx
+    assert back.flow_id == ctx.flow_id  # id derives from trace_id alone
+    child = ctx.child("prefill_dispatch")
+    assert child.trace_id == ctx.trace_id
+    assert child.span_id == "prefill_dispatch"
+    assert child.flow_id == ctx.flow_id
+    assert TraceContext.from_header(None) is None
+    assert TraceContext.from_header({}) is None
+
+
+def test_span_args_keep_numbers_numeric():
+    """ISSUE 13 satellite: int/float span args must stay NUMERIC in the
+    Chrome events so viewers/the stitcher can sort and aggregate on them
+    (trace ids stay strings; bools stringify for readability)."""
+    reg = Registry(enabled=True)
+    tracer = SpanTracer(registry=reg)
+    with tracer.span("s", count=7, wall=1.5, label="x", flag=True):
+        pass
+    ctx = TraceContext.mint()
+    with tracer.request_span("r", ctx, frid=3, share=0.25):
+        pass
+    events = {e["name"]: e for e in tracer.chrome_trace()["traceEvents"]
+              if e["ph"] == "B"}
+    args = events["s"]["args"]
+    assert args["count"] == 7 and isinstance(args["count"], int)
+    assert args["wall"] == 1.5 and isinstance(args["wall"], float)
+    assert args["label"] == "x"
+    assert args["flag"] == "True"
+    rargs = events["r"]["args"]
+    assert rargs["frid"] == 3 and isinstance(rargs["frid"], int)
+    assert rargs["share"] == 0.25
+    assert rargs["trace_id"] == ctx.trace_id  # identity stays a string
+    json.dumps(tracer.chrome_trace())  # chrome-loadable
+
+
+def test_request_span_emits_flow_and_instant_lifecycle():
+    reg = Registry(enabled=True)
+    tracer = SpanTracer(registry=reg)
+    ctx = TraceContext.mint()
+    with tracer.request_span("router_submit", ctx, flow="start"):
+        pass
+    tracer.flow("hop", ctx, phase="step")
+    tracer.instant("requeue", trace_id=ctx.trace_id, outcome="requeued")
+    tracer.flow("retire", ctx, phase="end")
+    events = tracer.chrome_trace()["traceEvents"]
+    phases = [e["ph"] for e in events]
+    assert phases == ["B", "s", "E", "t", "i", "f"]
+    flows = [e for e in events if e["ph"] in ("s", "t", "f")]
+    assert len({e["id"] for e in flows}) == 1  # one flow id per trace
+    assert all(e["cat"] == "request" for e in flows)
+    assert [e for e in events if e["ph"] == "f"][0]["bp"] == "e"
+    with pytest.raises(ValueError, match="flow phase"):
+        tracer.flow("x", ctx, phase="nope")
+
+
+def test_request_span_disabled_is_silent():
+    reg = Registry(enabled=False)
+    tracer = SpanTracer(registry=reg)
+    with tracer.request_span("r", TraceContext.mint(), flow="start"):
+        pass
+    tracer.flow("h", TraceContext.mint())
+    tracer.instant("i")
+    assert tracer.chrome_trace()["traceEvents"] == []
+
+
+# ---------------------------------------------------------------------------
+# exemplars
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_exemplars_land_in_their_bucket():
+    reg = Registry(enabled=True)
+    h = reg.histogram("lat_ms", labels=("role",),
+                      buckets=(1.0, 10.0, 100.0))
+    h.observe(0.5, exemplar="t-fast", role="r")
+    h.observe(50.0, exemplar="t-mid", role="r")
+    h.observe(5000.0, exemplar="t-tail", role="r")
+    h.observe(60.0, role="r")  # no exemplar: must not clobber t-mid
+    (rec,) = [r for r in reg.collect() if r["name"] == "lat_ms"]
+    ex = rec["exemplars"]
+    assert ex["1.0"]["trace_id"] == "t-fast"
+    assert ex["100.0"]["trace_id"] == "t-mid"
+    assert ex["+Inf"]["trace_id"] == "t-tail"
+    assert ex["+Inf"]["value"] == 5000.0
+    # the JSONL exposition carries them too (the /metrics.json payload is
+    # the same collect() records)
+    lines = [json.loads(ln) for ln in reg.to_jsonl().splitlines()]
+    assert any(r.get("exemplars", {}).get("+Inf", {}).get("trace_id")
+               == "t-tail" for r in lines)
+
+
+def test_exemplars_survive_the_fleet_merge():
+    from dsml_tpu.obs import cluster
+
+    snaps = []
+    for pid, tid in ((101, "t-a"), (102, "t-b")):
+        reg = Registry(enabled=True)
+        reg.histogram("lat_ms", labels=(), buckets=(1.0, 10.0)).observe(
+            500.0, exemplar=tid
+        )
+        snap = cluster.snapshot(role="w", registry=reg,
+                                tracer=SpanTracer(registry=reg))
+        snap["pid"] = pid
+        snaps.append(snap)
+    snaps[1]["metrics"][0]["exemplars"]["+Inf"]["time"] += 1e6  # newest
+    view = cluster.merge_snapshots(snaps)
+    (fleet,) = [r for r in view.collect() if r["name"] == "lat_ms:fleet"]
+    assert fleet["count"] == 2
+    assert fleet["exemplars"]["+Inf"]["trace_id"] == "t-b"  # newest wins
+
+
+# ---------------------------------------------------------------------------
+# burn-rate / window math — pinned against numpy
+# ---------------------------------------------------------------------------
+
+
+def test_window_compliance_matches_numpy():
+    rng = np.random.default_rng(3)
+    t = np.sort(rng.uniform(0, 100.0, 400))
+    good = rng.random(400) < 0.7
+    events = list(zip(t.tolist(), good.tolist()))
+    for now, window in ((100.0, 30.0), (100.0, 100.0), (50.0, 10.0)):
+        g, n = window_compliance(events, now, window)
+        mask = t > (now - window)
+        assert n == int(mask.sum())
+        assert g == int(good[mask].sum())
+
+
+def test_burn_rate_formula_and_status_matrix():
+    assert burn_rate(0.0, 0.99) == 0.0
+    assert burn_rate(0.01, 0.99) == pytest.approx(1.0)
+    assert burn_rate(1.0, 0.99) == pytest.approx(100.0)
+    assert burn_rate(0.05, 0.9) == pytest.approx(0.5)
+    with pytest.raises(ValueError):
+        burn_rate(0.5, 1.0)
+    # multi-window rule: BOTH windows must agree before escalating
+    assert status_from_burn(20.0, 20.0) == "page"
+    assert status_from_burn(20.0, 1.0) == "ok"    # fast-only blip
+    assert status_from_burn(1.0, 20.0) == "ok"    # stale slow excess
+    assert status_from_burn(8.0, 8.0) == "warn"
+    assert status_from_burn(0.5, 0.5) == "ok"
+
+
+def test_slo_tracker_windows_match_numpy_and_page():
+    clock = [0.0]
+    spec = SLOSpec("i", objective=0.9, ttft_budget_ms=100.0,
+                   fast_window_s=10.0, slow_window_s=50.0)
+    tracker = SLOTracker([spec], registry=Registry(enabled=False),
+                         clock=lambda: clock[0])
+    rng = np.random.default_rng(7)
+    times, goods = [], []
+    for _ in range(300):
+        clock[0] += float(rng.uniform(0.05, 0.4))
+        ttft = 50.0 if rng.random() < 0.6 else 200.0
+        times.append(clock[0])
+        goods.append(ttft <= 100.0)
+        tracker.record("i", ttft_ms=ttft)
+    t = np.asarray(times)
+    g = np.asarray(goods)
+    for window, w_s in (("fast", 10.0), ("slow", 50.0)):
+        b = tracker.burn("i", "ttft", window)
+        mask = t > (clock[0] - w_s)
+        total, good = int(mask.sum()), int(g[mask].sum())
+        assert b["total"] == total and b["good"] == good
+        bad_frac = (total - good) / total
+        assert b["burn"] == pytest.approx(bad_frac / (1 - 0.9))
+    # drive everything bad PAST the slow window length: both windows
+    # saturate at the burn ceiling -> page (the clamped threshold)
+    for _ in range(600):
+        clock[0] += 0.1
+        tracker.record("i", ttft_ms=500.0)
+    st = tracker.status("i", "ttft")
+    assert st["status"] == "page"
+    assert tracker.report()["i"]["status"] == "page"
+    # a None measurement = SLI not measurable for this request (TPOT on
+    # a single-token request): skipped — neither good nor bad, windows
+    # untouched (never-produced requests never reach record at all)
+    before = tracker.burn("i", "ttft", "slow")["total"]
+    v = tracker.record("i", ttft_ms=None)
+    assert "ttft" not in v
+    assert tracker.burn("i", "ttft", "slow")["total"] == before
+
+
+def test_exemplar_scrape_survives_concurrent_observes():
+    """collect() snapshots each series' exemplars under the metric lock:
+    observe() inserts new bucket keys concurrently (a dict resize), and
+    iterating the live dict from the scrape thread raised RuntimeError —
+    the first structure on the exposition path that could actually raise
+    rather than tear benignly."""
+    import threading
+
+    reg = Registry(enabled=True)
+    hist = reg.histogram("hammer_ms", labels=("replica",))
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            # cycle label values so fresh series (fresh exemplar dicts)
+            # keep being created and resized mid-scrape
+            hist.observe(float(i % 4000), exemplar=f"t-{i}",
+                         replica=str(i % 64))
+            i += 1
+
+    def reader():
+        try:
+            while not stop.is_set():
+                for rec in reg.collect():
+                    rec.get("exemplars")
+        except RuntimeError as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer), threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    import time as _time
+
+    _time.sleep(0.4)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
+def test_burn_status_gauge_refreshes_at_scrape_after_traffic_stops():
+    """The burn gauges depend on the CLOCK (rolling windows drain), not
+    just on ingest: a class that paged during a burst and then went idle
+    must read "ok" at the next scrape, not stay frozen at the last
+    ingest-time export forever (the registry collect hook re-exports)."""
+    clock = [0.0]
+    reg = Registry(enabled=True)
+    spec = SLOSpec("i", objective=0.9, ttft_budget_ms=100.0,
+                   fast_window_s=10.0, slow_window_s=50.0)
+    tracker = SLOTracker([spec], registry=reg, clock=lambda: clock[0])
+
+    def status_gauge():
+        for rec in reg.collect():
+            if (rec["name"] == "slo_burn_status"
+                    and rec["labels"] == {"slo": "i", "sli": "ttft"}):
+                return int(rec["value"])
+        return None
+
+    for _ in range(600):
+        clock[0] += 0.1
+        tracker.record("i", ttft_ms=500.0)  # everything bad -> page
+    assert status_gauge() == 2  # page, exported at ingest
+    # traffic STOPS; both windows drain completely
+    clock[0] += 60.0
+    assert tracker.status("i", "ttft")["status"] == "ok"  # ground truth
+    assert status_gauge() == 0  # scrape-time refresh, not frozen "page"
+    del tracker  # the weakly-held hook dies with its owner
+    assert status_gauge() == 0  # collect survives a dead hook
+
+
+def test_page_wait_flow_marks_once_per_episode():
+    """A request blocked on pool pressure for many admission ticks marks
+    its trace with ONE page_wait flow step — per-tick marks would flood
+    the causal chain and churn the bounded span buffer — while the
+    serving_page_wait_total counter still counts every blocked tick."""
+    from dsml_tpu import obs
+
+    model, cfg = _tiny()
+    params = model.init(0)
+    obs.enable(forensics=False)
+    try:
+        obs.get_tracer().reset()
+        # 10 allocatable pages of 8 rows; the first two requests reserve
+        # ~all of them for many decode ticks, the third waits at the head
+        paged = ContinuousBatcher(model, params, n_slots=4, prefill_chunk=8,
+                                  paged_kv="int4", page_size=8, n_pages=11)
+        busy = _prompts(cfg, [30, 28], seed=6)
+        for p in busy:
+            paged.submit(p, 8)
+        waiter = _prompts(cfg, [25], seed=7)[0]
+        ctx = TraceContext.mint()
+        paged.submit(waiter, 4, trace_id=ctx.trace_id)
+        for _ in range(30):
+            paged.step()
+        events = obs.get_tracer().chrome_trace()["traceEvents"]
+        marks = [e for e in events if e.get("name") == "page_wait"
+                 and (e.get("args") or {}).get("trace_id") == ctx.trace_id]
+        assert len(marks) == 1, f"expected one episode mark, got {len(marks)}"
+        waits = 0
+        for rec in obs.get_registry().collect():
+            if rec["name"] == "serving_page_wait_total":
+                waits += int(rec["value"])
+        assert waits > 1  # the counter DID count every blocked tick
+    finally:
+        obs.disable()
+
+
+def test_single_token_requests_do_not_burn_tpot_budget():
+    """The router computes TPOT only when a request produced >1 token
+    (router._harvest), so a max_new_tokens=1 / EOS-at-first-token fleet
+    records tpot_ms=None on every retirement. A class budgeting TPOT
+    must count those requests as fully GOOD (TPOT inapplicable), not pin
+    its burn at the ceiling under perfect short-traffic service."""
+    spec = SLOSpec("clf", objective=0.9, tpot_budget_ms=50.0,
+                   e2e_budget_ms=60_000.0)
+    clock = [0.0]
+    tracker = SLOTracker([spec], registry=Registry(enabled=False),
+                         clock=lambda: clock[0])
+    for _ in range(50):
+        clock[0] += 0.1
+        v = tracker.record("clf", ttft_ms=20.0, tpot_ms=None, e2e_ms=25.0)
+        assert v == {"e2e": True}
+    assert tracker.good_requests["clf"] == 50
+    assert tracker.burn("clf", "tpot", "slow")["total"] == 0
+    assert tracker.status("clf", "tpot")["status"] == "ok"
+    assert tracker.report()["clf"]["status"] == "ok"
+
+
+def test_tail_attribution_pinned_against_numpy():
+    rng = np.random.default_rng(11)
+    samples = []
+    for i in range(200):
+        stages = {"queue": float(rng.uniform(0, 0.01)),
+                  "prefill": float(rng.uniform(0, 0.05)),
+                  "handoff": float(rng.uniform(0, 0.002)),
+                  "first_decode": float(rng.uniform(0, 0.01)),
+                  "decode": float(rng.uniform(0, 0.03))}
+        # the tail (top 1%) is prefill-dominated by construction
+        e2e = sum(stages.values())
+        if i >= 198:
+            stages["prefill"] += 1.0
+            e2e += 1.0
+        samples.append((e2e, stages, f"t{i}"))
+    out = tail_attribution(samples, q=0.99)
+    e2e = np.asarray([s[0] for s in samples])
+    threshold = np.sort(e2e)[min(int(0.99 * len(e2e)), len(e2e) - 1)]
+    assert out["threshold_ms"] == pytest.approx(threshold * 1e3, abs=1e-3)
+    tail = [s for s in samples if s[0] >= threshold]
+    assert out["n_tail"] == len(tail)
+    want_prefill = np.mean([s[1]["prefill"] for s in tail]) * 1e3
+    assert out["stage_ms"]["prefill"] == pytest.approx(want_prefill,
+                                                       abs=1e-3)
+    assert out["dominant_stage"] == "prefill"
+    worst = max(tail, key=lambda s: s[0])
+    assert out["worst_trace_id"] == worst[2]
+    assert tail_attribution([]) is None
+
+
+# ---------------------------------------------------------------------------
+# router integration: bounded buffers, propagation, SLO report, exemplars
+# ---------------------------------------------------------------------------
+
+
+def test_router_sample_buffer_is_bounded(monkeypatch):
+    """ISSUE 13 satellite: the raw per-request sample buffer must not
+    grow host memory without bound — overflow is counted, never silent."""
+    monkeypatch.setenv("DSML_SERVING_SAMPLES", "4")
+    model, cfg = _tiny()
+    params = model.init(0)
+    fleet = build_fleet(model, params, n_prefill=1, n_decode=1,
+                        prefill_chunk=8, n_slots=2)
+    for p in _prompts(cfg, [5, 7, 9, 6, 8, 5, 7], seed=1):
+        fleet.submit(p, 3)
+    fleet.run()
+    assert len(fleet.latency_samples) == 4
+    assert fleet.dropped_samples == 3
+    # the bounded record ledger keeps the NEWEST requests
+    assert len(fleet.request_records) == 4
+
+
+def test_fleet_trace_propagates_and_slo_reports():
+    """The single-process end-to-end: every retired request has a distinct
+    trace_id; router/prefill spans share it; a serving_ttft_ms exemplar
+    resolves to a real retired trace; the SLO classes report burn status
+    and the fleet merge carries the slo section."""
+    from dsml_tpu import obs
+    from dsml_tpu.obs import cluster
+
+    model, cfg = _tiny()
+    params = model.init(0)
+    obs.enable(forensics=False)
+    try:
+        obs.get_tracer().reset()
+        fleet = build_fleet(
+            model, params, n_prefill=2, n_decode=2, prefill_chunk=8,
+            n_slots=2,
+            slo_classes=[
+                SLOClass("interactive", tpot_budget_ms=60_000.0,
+                         e2e_budget_ms=120_000.0, objective=0.9),
+                SLOClass("batch", priority=1),
+            ],
+        )
+        prompts = _prompts(cfg, [5, 17, 26], seed=2)
+        frids = [fleet.submit(p, 4, slo="interactive") for p in prompts]
+        fleet.run()
+        records = {f: fleet.request_records[f] for f in frids}
+        tids = {r["trace_id"] for r in records.values()}
+        assert len(tids) == 3 and None not in tids
+        assert all(r["retries"] == 0 for r in records.values())
+        # stage split covers the TTFT path for every request
+        for r in records.values():
+            for stage in ("queue", "prefill", "handoff", "first_decode"):
+                assert stage in r["stages_s"]
+        # spans: router_submit and prefill_chunk both carry each trace
+        summary = cluster.trace_summary(
+            obs.get_tracer().chrome_trace()
+        )
+        for tid in tids:
+            row = summary[tid]
+            assert "router_submit" in row["names"]
+            assert "prefill_chunk" in row["names"]
+            assert row["flow"].get("s") == 1
+            assert row["flow"].get("f") == 1
+            assert row["flow"].get("t", 0) >= 1
+        # exemplar: a serving_ttft_ms tail bucket resolves to a retired
+        # request's trace
+        (rec,) = [r for r in obs.get_registry().collect()
+                  if r["name"] == "serving_ttft_ms"]
+        ex_tids = {e["trace_id"] for e in rec["exemplars"].values()}
+        assert ex_tids and ex_tids <= tids
+        # SLO accounting: measured compliance + burn status per class
+        rep = fleet.slo.report()
+        assert rep["interactive"]["requests"] == 3
+        assert set(rep["interactive"]["sli"]) == {"tpot", "e2e"}
+        assert rep["interactive"]["status"] in ("ok", "warn", "page")
+        assert rep["interactive"]["tail"]["dominant_stage"]
+        # fleet-wide merge: MergedView.report() carries the slo section
+        view = cluster.merge_snapshots([cluster.snapshot(role="router")])
+        slo = view.report()["slo"]
+        assert slo["interactive"]["requests"] == 3
+        assert slo["interactive"]["objective"] == 0.9
+        assert slo["interactive"]["sli"]["e2e"]["compliance"] == 1.0
+        assert slo["interactive"]["sli"]["e2e"]["burn_total"] == 0.0
+        assert slo["interactive"]["status"] in ("ok", "warn", "page")
+    finally:
+        obs.disable()
+
+
+def test_requeue_keeps_trace_and_burns_full_latency():
+    """ISSUE 13 chaos satellite (in-process leg): a killed worker's
+    requeued request retires under the SAME trace_id, with a retry span
+    (outcome="requeued") on its chain, and its e2e counts the full
+    user-visible latency — strictly more than the post-requeue leg."""
+    from dsml_tpu import obs
+    from dsml_tpu.runtime.chaos import run_chaos_serving_fleet
+
+    model, cfg = _tiny()
+    params = model.init(0)
+    obs.enable(forensics=False)
+    try:
+        obs.get_tracer().reset()
+        fleet = build_fleet(model, params, n_prefill=2, n_decode=2,
+                            prefill_chunk=8, n_slots=2, max_queue=8)
+        rng = np.random.default_rng(9)
+        prompts = [
+            rng.integers(1, cfg.vocab_size,
+                         rng.integers(8, 24)).astype(np.int32)
+            for _ in range(6)
+        ]
+        out = run_chaos_serving_fleet(
+            fleet, prompts, 6,
+            kill_ticks={1: ("prefill", None), 6: ("decode", None)},
+        )
+        assert out["requeued_requests"] >= 1
+        assert out["trace_requeue_same"] == 1
+        assert out["trace_retry_recorded"] == 1
+        assert out["trace_burn_full_latency"] == 1
+        # the requeue left a visible retry span with outcome="requeued"
+        events = obs.get_tracer().chrome_trace()["traceEvents"]
+        retries = [e for e in events
+                   if e.get("name") == "serving_request_retry"
+                   and e["ph"] == "B"]
+        assert retries
+        assert all(e["args"]["outcome"] == "requeued" for e in retries)
+        assert all(e["args"]["trace_id"] for e in retries)
+    finally:
+        obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# the >=3-process acceptance: stitched timeline with flow links
+# ---------------------------------------------------------------------------
+
+
+def test_request_trace_spans_three_process_lanes_when_stitched():
+    """The acceptance geometry: router → prefill → decode as THREE
+    processes (each stage snapshots its own trace under a distinct pid —
+    exactly what three hosts would push to the aggregator), stitched into
+    one timeline where the request's trace-tagged events land in >=3 pid
+    lanes linked by one flow id (s → t... → f)."""
+    from dsml_tpu import obs
+    from dsml_tpu.obs import cluster
+    from dsml_tpu.obs.spans import TraceContext
+    from dsml_tpu.serving import PrefillWorker, decode_handoff, encode_handoff
+
+    model, cfg = _tiny()
+    params = model.init(0)
+    prompt = _prompts(cfg, [13], seed=5)[0]
+    obs.enable(forensics=False)
+    tracer = obs.get_tracer()
+    snaps = []
+
+    def stage_snapshot(role, pid):
+        snap = cluster.snapshot(role=role)
+        snap["pid"] = pid  # what a real per-host process would stamp
+        for e in snap["trace"]["traceEvents"]:
+            e["pid"] = pid
+        snaps.append(snap)
+        tracer.reset()
+
+    try:
+        tracer.reset()
+        # -- process 1: the router mints + dispatches ----------------------
+        ctx = TraceContext.mint(span_id="router_submit")
+        with tracer.request_span("router_submit", ctx, flow="start",
+                                 frid=0, prompt_len=len(prompt)):
+            pass
+        stage_snapshot("router", 9001)
+        # -- process 2: the prefill worker runs the chunks -----------------
+        pw = PrefillWorker(model, params, prefill_chunk=8)
+        pw.submit(prompt, 4, frid=0, key_rid=0,
+                  trace=ctx.child("prefill_dispatch"))
+        handoff = None
+        for _ in range(64):
+            done = pw.step()
+            if done:
+                handoff = done[0]
+                break
+        assert handoff is not None
+        assert handoff.trace_id == ctx.trace_id
+        wire = decode_handoff(encode_handoff(handoff))  # the codec hop
+        assert wire.trace_id == ctx.trace_id
+        stage_snapshot("prefill", 9002)
+        # -- process 3: the decode worker injects + retires ----------------
+        dw = ContinuousBatcher(model, params, n_slots=2)
+        dw.inject(wire.prompt, wire.max_new_tokens, wire.cache1,
+                  wire.logits, key_rid=wire.key_rid,
+                  trace_id=wire.trace_id)
+        dw.run()
+        stage_snapshot("decode", 9003)
+    finally:
+        obs.disable()
+
+    stitched = cluster.stitch_traces(snaps)
+    summary = cluster.trace_summary(stitched)
+    row = summary[ctx.trace_id]
+    assert len(row["pids"]) >= 3  # router → prefill → decode lanes
+    assert row["flow"].get("s") == 1
+    assert row["flow"].get("t", 0) >= 2  # handoff emit + decode inject
+    assert row["flow"].get("f") == 1
+    assert "router_submit" in row["names"]
+    assert "prefill_chunk" in row["names"]
+    assert "serving_first_token" in row["names"]
+    json.dumps(stitched)  # chrome-loadable

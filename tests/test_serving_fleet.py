@@ -129,9 +129,9 @@ def test_disagg_prefix_cache_hit_identity():
 # ---------------------------------------------------------------------------
 
 
-def _one_handoff(model, params, prompt, max_new=4):
+def _one_handoff(model, params, prompt, max_new=4, trace=None):
     pw = PrefillWorker(model, params, prefill_chunk=8)
-    pw.submit(prompt, max_new, frid=0, key_rid=0)
+    pw.submit(prompt, max_new, frid=0, key_rid=0, trace=trace)
     for _ in range(64):
         done = pw.step()
         if done:
@@ -140,15 +140,24 @@ def _one_handoff(model, params, prompt, max_new=4):
 
 
 def test_handoff_codec_round_trip_and_corruption():
-    """encode→decode is bit-exact for every cache leaf + the logits; a
-    single flipped payload byte fails CRC validation loudly (the
-    migration-path contract: corruption never lands in a cache)."""
+    """encode→decode is bit-exact for every cache leaf + the logits; the
+    request's TRACE identity survives the framed wire (ISSUE 13 — the
+    decode host joins the same causal chain); a single flipped payload
+    byte fails CRC validation loudly (the migration-path contract:
+    corruption never lands in a cache)."""
+    from dsml_tpu.obs import TraceContext
+
     model, cfg = _small()
     params = model.init(0)
-    h = _one_handoff(model, params, _prompts(cfg, [13], seed=1)[0])
+    ctx = TraceContext.mint(span_id="router_submit")
+    h = _one_handoff(model, params, _prompts(cfg, [13], seed=1)[0],
+                     trace=ctx)
+    assert h.trace_id == ctx.trace_id
     frame = encode_handoff(h)
     back = decode_handoff(frame)
     assert back.frid == h.frid and back.prefill_len == h.prefill_len
+    assert back.trace_id == ctx.trace_id
+    assert back.parent_span == h.parent_span
     np.testing.assert_array_equal(back.prompt, h.prompt)
     np.testing.assert_array_equal(back.logits, np.asarray(h.logits))
     for got_l, want_l in zip(back.cache1, h.cache1):
@@ -184,9 +193,13 @@ def test_cross_worker_handoff_over_real_streams():
     prefill host registers the handoff with its device server's
     ``StateDonor``; the decode host pulls it with a ``ShardMigrator`` over
     real gRPC ``BeginSend``/``StreamSend`` (per-frame CRC32C, resumable
-    offsets) — then injects and decodes reference-identical tokens."""
+    offsets) — then injects and decodes reference-identical tokens. The
+    request's TRACE identity rides the donor descriptor header AND the
+    per-key donor table, so the pull (and the decode side's spans) stays
+    attributable to the originating trace (ISSUE 13)."""
     from dsml_tpu.comm.device_server import serve_device
     from dsml_tpu.comm.migration import MigrationConfig, ShardMigrator
+    from dsml_tpu.obs import TraceContext
     from dsml_tpu.serving import fetch_from_migrator, register_with_donor
 
     model, cfg = _small()
@@ -195,7 +208,8 @@ def test_cross_worker_handoff_over_real_streams():
     max_new = 5
     want = _reference_tokens(model, params, [prompt], [max_new])[0]
 
-    h = _one_handoff(model, params, prompt, max_new)
+    ctx = TraceContext.mint(span_id="router_submit")
+    h = _one_handoff(model, params, prompt, max_new, trace=ctx)
     recv = serve_device(211, mem_size=0x400000)
     donor = serve_device(212, mem_size=0x400000)
     try:
@@ -203,6 +217,12 @@ def test_cross_worker_handoff_over_real_streams():
         recv.runtime.configure_peers(peers, 0)
         donor.runtime.configure_peers(peers, 1)
         desc = register_with_donor(donor.runtime.donor, h)
+        assert desc["header"]["trace_id"] == ctx.trace_id
+        # the donor's piece-plan answers carry the trace too — the wire
+        # stream descriptors a remote puller sees are attributable
+        key = f"{desc['prefix']}/0/k"
+        plan = donor.runtime.donor.plan([key])
+        assert plan[key]["trace_id"] == ctx.trace_id
         mig = ShardMigrator(
             recv.runtime, 0, [(1, donor.address)],
             config=MigrationConfig(timeout_s=10.0),
@@ -215,9 +235,11 @@ def test_cross_worker_handoff_over_real_streams():
         recv.stop()
         donor.stop()
 
+    assert pulled.trace_id == ctx.trace_id  # survived the real gRPC pull
     dw = ContinuousBatcher(model, params, n_slots=2)
     rid = dw.inject(pulled.prompt, pulled.max_new_tokens, pulled.cache1,
-                    pulled.logits, key_rid=pulled.key_rid)
+                    pulled.logits, key_rid=pulled.key_rid,
+                    trace_id=pulled.trace_id)
     out = dw.run()
     assert out[rid] == want
 
