@@ -1454,17 +1454,38 @@ class GPT2:
                            valid, write, tp_axis, mode, read_index=None):
         """:meth:`_decode_core` against a page pool: per layer — norm →
         qkv → quantized page write (the caller's scatter placement) →
-        page-table-gathered cached attention → wo/psum → ffn. The three
-        paged serving surfaces (decode / chunked prefill / verify) differ
-        only in positions/valid/write, exactly like their dense twins."""
+        paged-attention read → wo/psum → ffn. The three paged serving
+        surfaces (decode / chunked prefill / verify) differ only in
+        positions/valid/write, exactly like their dense twins.
+
+        The attention read routes per ``DSML_PAGED_ATTN`` (trace-time):
+        the Pallas kernel walks the page table directly — one page DMA'd
+        per grid step, dequantized in-kernel, folded into a running
+        (out, lse) merge, dead/scratch entries skip-predicated — so the
+        dense ``[b, H, S, hd]`` view is never materialized and HBM
+        traffic scales with LIVE pages; the XLA gather path stays the
+        fallback and the parity oracle (``ops.paged_attention``). All
+        three surfaces' masks are ``key_pos <= query_pos``, which is why
+        one kernel serves them: ``positions`` broadcast to [b, C] IS the
+        mask."""
+        from dsml_tpu.ops.paged_attention import paged_attention, paged_attn_impl
+
+        use_pallas = paged_attn_impl() == "pallas"
+        b_q, c_q = h.shape[0], h.shape[1]
+        posq = jnp.broadcast_to(
+            jnp.atleast_2d(jnp.asarray(positions, jnp.int32)), (b_q, c_q)
+        )
         tp_size = lax.axis_size(tp_axis) if tp_axis else 1
         new_pool = []
         for layer, c in zip(params["layers"], pool):
             x = self._norm1(layer, h)
             q, kc, vc, _, _ = self._serving_qkv(layer, x, positions, tp_size)
             c = self._paged_write(c, kc, vc, write, mode)
-            ck, cv, k_s, v_s = self._paged_attn_inputs(c, page_table, mode)
-            out = self._decode_attention(q, ck, cv, valid, k_s, v_s)
+            if use_pallas:
+                out = paged_attention(q, c, page_table, posq, mode)
+            else:
+                ck, cv, k_s, v_s = self._paged_attn_inputs(c, page_table, mode)
+                out = self._decode_attention(q, ck, cv, valid, k_s, v_s)
             attn_out = self._merge_heads(out) @ maybe_dequant(layer["attn"]["wo"], h.dtype)
             if tp_axis:
                 attn_out = lax.psum(attn_out, tp_axis)

@@ -190,6 +190,15 @@ _NOT_A_METRIC = (
     # "_trace_us" suffix and the tick walls via the "tick_ms" contains
     # rule
     "_ok", "dominant", "_burn", "tracing_interactive_", "tracing_batch_",
+    # paged_attention section: the analytic HBM A/B rows are EXACT
+    # program-structure counts (the "_bytes" rule above exempts them; a
+    # changed count is a schedule change the contract test pins), the
+    # live-shaped/table-shaped/parity/no-leak rows are `_ok` verdicts,
+    # and eviction counts are workload constants via "_events". The
+    # tick_p50_ms_live* walls gate down-good via the "tick_p50" contains
+    # rule below, tp2_capacity_ratio up-good via "capacity_ratio", and
+    # the preemption-vs-reservation throughput rows up-good via
+    # "tokens_per_sec".
     # long_context section: ladder geometry + analytic accounting rows.
     # The KV wire-byte rows are EXACT schedule counts (the generic "_bytes"
     # rule above already exempts them — a changed count is a schedule
@@ -221,7 +230,12 @@ _LOWER_BETTER_CONTAINS = ("loss", "overhead", "stall", "latency", "ttft",
                           # SUFFIX rule misses them — the enabled-vs-
                           # disabled A/B is the end-to-end cost this
                           # section exists to watch
-                          "tpot", "tick_ms")
+                          "tpot", "tick_ms",
+                          # "tick_p50": the paged_attention section's
+                          # per-live-fraction decode-tick walls
+                          # (tick_p50_ms_live25/...): the _ms SUFFIX rule
+                          # misses the trailing fraction tag
+                          "tick_p50")
 
 
 def metric_direction(name: str) -> str | None:

@@ -1,0 +1,317 @@
+"""Pallas paged-attention decode kernel — gather-free reads of the KV page
+pool (the vLLM paged-attention kernel shape, PAPERS.md).
+
+The XLA paged path (``GPT2._paged_attn_inputs``) gathers ``pool[page_table]``
+into a dense ``[b, H, max_seq, hd]`` view per layer per tick. On real chips
+that round-trips the ENTIRE table width through HBM — gather read, dense
+materialization write, attention read — every tick, which erases most of the
+paged cache's bandwidth win (capacity still holds; traffic doesn't). This
+kernel walks the page table directly instead:
+
+- **One page per grid step.** The table rides as a SCALAR-PREFETCH operand
+  (``pltpu.PrefetchScalarGridSpec``), so the K/V BlockSpec index maps read
+  ``table[b, t]`` and Pallas DMAs exactly that physical page's rows into
+  VMEM for grid step ``(b, kv_head, t)`` — the dense view is never
+  materialized, and HBM traffic is proportional to the pages the table
+  actually names (:func:`paged_hbm_bytes` is the analytic accounting
+  the bench's A/B table uses).
+- **In-kernel dequantize.** int4 pages unpack their nibbles (the shared
+  ``pack_int4`` layout: channel halves contiguous) and both int4/int8 fold
+  the per-row scales from ``quantize_kv_rows`` exactly where the XLA path
+  does — key scales after the q·k dot, value scales into the probabilities
+  before the p·v dot — so the math is the same sum in a different order.
+- **Running (out, lse) merge.** Pages fold into online-softmax accumulators
+  (running row-max, running denominator — the same logsumexp-merge shape as
+  ``ops.ring_attention``'s hop merge), held in VMEM scratch across the
+  page-walk grid dimension.
+- **Dead-page skipping.** The batcher's sanitized table points every entry
+  past a slot's live depth (and every dead slot's entire row) at the
+  scratch page 0; pages whose first row is beyond every resident query's
+  position skip compute via ``pl.when``, and the repeated scratch-page
+  block index collapses to one resident copy — live work, not pool size,
+  sets the bill.
+- **GQA for free.** Query heads group over their kv head exactly like
+  ``Llama._decode_attention``: the grid walks KV heads and each step's q
+  block is that head's query GROUP (``rep × C`` rows), so one kernel serves
+  GPT-2 (rep=1) and Llama (rep>1), dense-parity pinned for both.
+
+Routing: ``DSML_PAGED_ATTN=pallas|xla`` (:func:`paged_attn_impl`; default
+pallas on TPU, xla elsewhere — the gather path stays the fallback and the
+parity oracle). All three paged serving surfaces (decode / chunked prefill /
+speculative verify) route through here via ``_decode_core_paged``: their
+masks are all ``key_pos <= query_pos``, which is the one mask this kernel
+implements. On non-TPU backends the kernel runs under the Pallas
+interpreter, which is how CI pins parity on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports on CPU builds too; guard anyway (ops/flash.py idiom)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+__all__ = [
+    "paged_attention",
+    "paged_attn_impl",
+    "paged_hbm_bytes",
+]
+
+_NEG_INF = -1e30
+_MAX_FLOOR = -1e20  # running-max floor: exp() stays sane on fully-masked rows
+
+
+def paged_attn_impl() -> str:
+    """The paged-attention routing knob: ``DSML_PAGED_ATTN`` ∈
+    {"pallas", "xla"}; unset/malformed defaults to the Pallas kernel on
+    TPU and the XLA gather elsewhere (the kernel still RUNS off-TPU via
+    the interpreter — tests opt in explicitly — but interpreted ticks are
+    the wrong default for a CPU serving loop). Read at trace time: a
+    batcher compiles its programs once, so flip the env before
+    construction, not between ticks."""
+    raw = os.environ.get("DSML_PAGED_ATTN", "").strip().lower()
+    if raw in ("pallas", "xla"):
+        return raw
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def _vmem_spec(block_shape, index_map):
+    if pltpu is not None:
+        return pl.BlockSpec(block_shape, index_map, memory_space=pltpu.VMEM)
+    return pl.BlockSpec(block_shape, index_map)  # pragma: no cover
+
+
+def _scratch(shape):
+    if pltpu is not None:
+        return pltpu.VMEM(shape, jnp.float32)
+    return pl.MemoryRef(shape, jnp.float32)  # pragma: no cover
+
+
+def _kernel(table_ref, q_ref, pos_ref, k_ref, v_ref, *rest, mode, scale,
+            page_size, n_pt, g_rows):
+    """One (batch row, kv head, table entry) grid step: DMA'd page →
+    dequantize → masked scores → online-softmax fold into the running
+    (acc, m, l) scratch. ``rest`` is ``(k_s_ref, v_s_ref, o_ref, acc, m,
+    l)`` for quantized pools and ``(o_ref, acc, m, l)`` for fp pages."""
+    if mode:
+        k_s_ref, v_s_ref, o_ref, acc, m_scr, l_scr = rest
+    else:
+        k_s_ref, v_s_ref = None, None
+        o_ref, acc, m_scr, l_scr = rest
+    t = pl.program_id(2)
+
+    @pl.when(t == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _MAX_FLOOR)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    posq = pos_ref[0, 0].reshape(g_rows, 1)  # [G, 1] global query positions
+    # pages whose FIRST row is past every resident query are fully masked
+    # for this batch row — skip the compute (the sanitized table routes
+    # them at the scratch page, whose repeated block index Pallas fetches
+    # once; the skip is what keeps the MXU bill proportional to live rows)
+    max_pos = jnp.max(posq)
+
+    @pl.when(t * page_size <= max_pos)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [G, hd]
+        kv_raw = k_ref[0, 0]
+        if mode == "int4":
+            hi = (kv_raw >> 4).astype(jnp.int8) - 8
+            lo = (kv_raw & 0xF).astype(jnp.int8) - 8
+            k = jnp.concatenate([hi, lo], axis=-1).astype(jnp.float32)
+        else:
+            k = kv_raw.astype(jnp.float32)  # int8 or fp rows
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [G, page]
+        if mode:
+            # per-row key scales fold AFTER the dot — identical math to the
+            # XLA path's scores * k_s^T (scales are constant along hd)
+            s = s * k_s_ref[0, 0].reshape(1, page_size)
+        k_pos = t * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (g_rows, page_size), 1
+        )
+        s = jnp.where(k_pos <= posq, s, _NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * corr + jnp.sum(p, -1, keepdims=True), l_scr.shape
+        )
+        if mode == "int4":
+            v_raw = v_ref[0, 0]
+            hi = (v_raw >> 4).astype(jnp.int8) - 8
+            lo = (v_raw & 0xF).astype(jnp.int8) - 8
+            v = jnp.concatenate([hi, lo], axis=-1).astype(jnp.float32)
+        else:
+            v = v_ref[0, 0].astype(jnp.float32)
+        if mode:
+            # value scales fold into the probabilities BEFORE the p·v dot
+            # (probs * v_s^T in the XLA path)
+            p = p * v_s_ref[0, 0].reshape(1, page_size)
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(t == n_pt - 1)
+    def _finish():
+        l_fin = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc[:] / l_fin).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array,
+    pool_layer: dict,
+    page_table: jax.Array,
+    positions: jax.Array,
+    mode: str | None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Decode attention straight off the page pool — no dense
+    ``[b, H, S, hd]`` view.
+
+    ``q`` [b, hq, C, hd] (C = 1 for decode, the window/chunk width for
+    verify/prefill); ``pool_layer`` is ONE layer's pool entry dict
+    (``k``/``v`` [P, hkv, page_size, ·] plus ``k_s``/``v_s`` [P, hkv,
+    page_size, 1] when quantized — ``init_page_pool``'s layout);
+    ``page_table`` [b, n_pt] int32 physical page per (slot, logical page)
+    — the batcher's SANITIZED table (dead slots/entries at scratch page
+    0); ``positions`` [b, C] int32 global positions of the query rows.
+    The mask is ``key_pos <= query_pos`` — exactly the ``valid`` mask all
+    three paged serving surfaces pass the XLA path. ``mode`` ∈ {None,
+    "int8", "int4"} is the pool codec. Returns [b, hq, C, hd] in
+    ``q.dtype``; numeric parity with the gather path and greedy-token
+    bit-identity through the paged batcher are pinned in tests."""
+    if mode not in (None, "int8", "int4"):
+        raise ValueError(f"unknown page quant mode {mode!r}")
+    b, hq, c, hd = q.shape
+    n_pages, hkv, page_size, _ = pool_layer["k"].shape
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} not grouped by kv heads {hkv}")
+    n_pt = page_table.shape[1]
+    rep = hq // hkv
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # group query heads over their kv head (the GQA grouping rule — head
+    # h serves kv head h // rep, matching Llama._decode_attention), then
+    # flatten (rep, C) into one query-row axis: all of a kv head's queries
+    # share its pages, so one grid step scores the whole group
+    qg = q.reshape(b, hkv, rep, c, hd).reshape(b, hkv, rep * c, hd)
+    posq = jnp.broadcast_to(
+        jnp.asarray(positions, jnp.int32)[:, None, :], (b, rep, c)
+    ).reshape(b, rep * c)
+    g = rep * c
+    gp = max(8, -(-g // 8) * 8)  # sublane-tileable query-row count
+    if gp != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+        # padded rows mask everything (-1 admits no key position); their
+        # zero q rows produce finite garbage that is sliced off below
+        posq = jnp.pad(posq, ((0, 0), (0, gp - g)), constant_values=-1)
+    # positions ride VMEM broadcast over 8 sublanes (the flash lse trick:
+    # the block shape stays Mosaic-tileable)
+    pos8 = jnp.broadcast_to(posq[:, None, :], (b, 8, gp))
+
+    kernel = functools.partial(
+        _kernel, mode=mode, scale=hd ** -0.5, page_size=page_size,
+        n_pt=n_pt, g_rows=gp,
+    )
+    in_specs = [
+        _vmem_spec((1, 1, gp, hd), lambda bi, hi, ti, tab: (bi, hi, 0, 0)),
+        _vmem_spec((1, 8, gp), lambda bi, hi, ti, tab: (bi, 0, 0)),
+        # the page walk: table[b, t] names the physical page this grid
+        # step reads — Pallas DMAs that page's rows, nothing else
+        _vmem_spec((1, 1, page_size, pool_layer["k"].shape[-1]),
+                   lambda bi, hi, ti, tab: (tab[bi, ti], hi, 0, 0)),
+        _vmem_spec((1, 1, page_size, pool_layer["v"].shape[-1]),
+                   lambda bi, hi, ti, tab: (tab[bi, ti], hi, 0, 0)),
+    ]
+    operands = [qg, pos8, pool_layer["k"], pool_layer["v"]]
+    if mode:
+        in_specs += [
+            _vmem_spec((1, 1, page_size, 1),
+                       lambda bi, hi, ti, tab: (tab[bi, ti], hi, 0, 0)),
+            _vmem_spec((1, 1, page_size, 1),
+                       lambda bi, hi, ti, tab: (tab[bi, ti], hi, 0, 0)),
+        ]
+        operands += [pool_layer["k_s"], pool_layer["v_s"]]
+
+    if pltpu is not None:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, hkv, n_pt),
+            in_specs=in_specs,
+            out_specs=_vmem_spec((1, 1, gp, hd),
+                                 lambda bi, hi, ti, tab: (bi, hi, 0, 0)),
+            scratch_shapes=[
+                _scratch((gp, hd)), _scratch((gp, 128)), _scratch((gp, 128)),
+            ],
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, hkv, gp, hd), jnp.float32),
+            interpret=interpret,
+        )(jnp.asarray(page_table, jnp.int32), *operands)
+    else:  # pragma: no cover — pltpu always importable on supported builds
+        raise RuntimeError("pallas TPU frontend unavailable")
+    out = out[:, :, :g].reshape(b, hkv, rep, c, hd).reshape(b, hq, c, hd)
+    return out.astype(q.dtype)
+
+
+def paged_hbm_bytes(
+    n_slots: int,
+    n_pt: int,
+    page_size: int,
+    n_kv_head: int,
+    head_dim: int,
+    mode: str | None,
+    live_pages: int,
+    impl: str,
+    n_query_rows: int = 1,
+) -> int:
+    """Analytic HBM bytes ONE layer's paged-attention read costs per
+    decode tick — counted from the program structure, not sampled (the
+    ``collectives.ring_wire_bytes`` contract), with the scratch-page
+    term charged at its worst case. The bench's A/B table and the
+    contract test's scales-with-live-work assertion both read this.
+
+    ``impl="xla"`` — the gather path's bill is TABLE-shaped: it reads one
+    page per table entry for every slot (``n_slots × n_pt`` pages, the
+    scratch page re-read per duplicate entry), writes the gathered dense
+    view, and reads that view back in the attention dots — regardless of
+    how many rows are live. ``impl="pallas"`` — the kernel's bill is
+    LIVE-shaped: ``live_pages`` counts live TABLE ENTRIES summed over
+    slots (a CoW-shared page counts once per slot naming it — each
+    (slot, head) grid row DMAs its own copy), each entry fetches once
+    per kv head, and each slot's dead-entry tail re-fetches the scratch
+    page once per (slot, head) run — the ``+ n_slots`` term (a slot with
+    zero dead entries skips it; this model charges the worst case).
+    Query/output bytes ride both and are counted for honesty; they are
+    noise next to the pool traffic."""
+    from dsml_tpu.ops.quantization import kv_row_bytes
+
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown paged-attention impl {impl!r}")
+    row = 2 * kv_row_bytes(head_dim, mode)  # one position's K + V (+scales)
+    page_bytes = n_kv_head * page_size * row
+    qo_bytes = 2 * n_slots * n_kv_head * n_query_rows * head_dim * 4
+    if impl == "pallas":
+        return (live_pages + n_slots) * page_bytes + qo_bytes
+    gathered = n_slots * n_pt * page_bytes  # pool read, table-shaped
+    # dense view materialized in the unpacked int8 (or fp) row width plus
+    # scales, written once and read back by the attention dots
+    dense_row = 2 * (head_dim + 4) if mode else 2 * 4 * head_dim
+    dense = n_slots * n_pt * page_size * n_kv_head * dense_row
+    return gathered + 2 * dense + qo_bytes

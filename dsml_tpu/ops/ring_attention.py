@@ -57,7 +57,9 @@ from jax import lax
 from dsml_tpu.ops.collectives import ring_pass
 from dsml_tpu.ops.flash import flash_attention, flash_attention_lse, flash_block_grads
 
-__all__ = ["ring_attention", "ring_kv_wire_bytes", "causal_keep_fraction"]
+__all__ = ["ring_attention", "ring_kv_wire_bytes", "causal_keep_fraction",
+           "causal_critical_path_fraction", "zigzag_indices",
+           "zigzag_inverse"]
 
 _LSE_FLOOR = -1e30  # "nothing seen": logaddexp identity, exp(floor − x) = 0
 
@@ -72,6 +74,86 @@ def _halves(s_local: int) -> list[tuple[int, int, int]]:
             if length > 0]
 
 
+# ---------------------------------------------------------------------------
+# zigzag/striped shard layout (the causal load-balance fix)
+# ---------------------------------------------------------------------------
+# Contiguous sharding makes rank r execute ~(r+1)/n of the causal hop grid:
+# rank 0 sees almost nothing unmasked, rank n−1 everything — late ranks ARE
+# the critical path, so causal skipping saves mean MXU time but not wall
+# time. The zigzag layout splits the sequence into 2n STRIPES and hands
+# rank r stripes {r, 2n−1−r} (an early stripe paired with a late one — the
+# Llama-3 / zigzag-ring trick): each rank then executes exactly (2n+1) of
+# its 4n (q-stripe × kv-stripe) pairs, CONSTANT across ranks, so the
+# critical path drops from ~1.0 of the grid to (2n+1)/4n ≈ ½ — the further
+# ~2× at large cp the ROADMAP names. Wire volume is unchanged (every block
+# still tours the ring); only WHERE the unmasked work lands moves. The
+# caller owns the row placement: shard `x[..., zigzag_indices(n, S), :]`
+# over cp and un-permute outputs with `zigzag_inverse` (positions fed to
+# the model must ride the same permutation — parity pinned in tests).
+
+
+def zigzag_indices(n_ranks: int, s_global: int) -> "np.ndarray":
+    """Row permutation placing stripes {r, 2n−1−r} on rank r: sharding
+    ``x[..., zigzag_indices(n, S), :]`` contiguously over cp gives every
+    rank its zigzag shard. Requires ``s_global % (2·n_ranks) == 0``."""
+    import numpy as np
+
+    n = int(n_ranks)
+    if s_global % (2 * n):
+        raise ValueError(
+            f"zigzag needs 2·cp stripes: {s_global} rows not divisible by "
+            f"{2 * n}"
+        )
+    stripe = s_global // (2 * n)
+    order = []
+    for r in range(n):
+        order.extend(range(r * stripe, (r + 1) * stripe))
+        order.extend(range((2 * n - 1 - r) * stripe, (2 * n - r) * stripe))
+    return np.asarray(order, np.int32)
+
+
+def zigzag_inverse(n_ranks: int, s_global: int) -> "np.ndarray":
+    """Inverse permutation: ``out[..., zigzag_inverse(n, S), :]`` restores
+    global row order from a zigzag-sharded result."""
+    import numpy as np
+
+    perm = zigzag_indices(n_ranks, s_global)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm), dtype=np.int32)
+    return inv
+
+
+def _zig_halves(s_local: int) -> list[tuple[int, int, int]]:
+    """The zigzag KV split: the two resident STRIPES are the two ring
+    halves (early stripe forward, late stripe backward) — equal lengths
+    by construction, so the full-duplex volume split stays exact."""
+    if s_local % 2:
+        raise ValueError(
+            f"zigzag needs an even per-rank length, got {s_local}"
+        )
+    st = s_local // 2
+    return [(0, st, +1), (st, st, -1)]
+
+
+def _q_blocks(layout: str, rank, s_local: int, n):
+    """(row_start, row_len, global_start) for this rank's query blocks.
+    Contiguous: one block at rank·s_local. Zigzag: the two stripes at
+    their interleaved global origins (``rank`` may be traced)."""
+    if layout == "zigzag":
+        st = s_local // 2
+        return [(0, st, rank * st), (st, st, (2 * n - 1 - rank) * st)]
+    return [(0, s_local, rank * s_local)]
+
+
+def _kv_global_start(layout: str, src, start: int, s_local: int, n):
+    """Global position of a visiting KV half's first row, given its
+    source rank (traced) and local row offset."""
+    if layout == "zigzag":
+        st = s_local // 2
+        return src * st if start == 0 else (2 * n - 1 - src) * st
+    return src * s_local + start
+
+
 def _merge(run_out, run_lse, o, l):
     """Fold one hop's (out, lse) into the running pair with logsumexp
     weights (both f32). Skipped hops contribute (0, _LSE_FLOOR) — weight 0."""
@@ -81,18 +163,38 @@ def _merge(run_out, run_lse, o, l):
     return w_prev * run_out + w_new * o, new_lse
 
 
-def _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k, interpret):
+def _keep_pair(layout, causal, hop, src, rank, k_start, q_gs, q_len):
+    """(statically_known_keep, traced_predicate_or_None) for one
+    (q block, visiting kv half) pair. Contiguous keeps its pinned rule —
+    hop 0 unconditionally, later hops predicate on ``src <= rank`` (the
+    whole-shard form). Zigzag predicates STRIPE-level causality at every
+    hop (``kv_start <= q_end``): a rank's late stripe admits every early
+    stripe and its early stripe rejects almost everything — the per-rank
+    executed-pair count lands constant at 2n+1 (see
+    :func:`causal_keep_fraction`)."""
+    if not causal:
+        return True, None
+    if layout == "zigzag":
+        return False, k_start <= q_gs + q_len - 1
+    if hop == 0:
+        return True, None
+    return False, src <= rank
+
+
+def _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k, interpret,
+                   layout):
     """n-hop bidirectional forward. Returns (out f32, lse f32) — exact full
-    attention for this rank's query shard."""
+    attention for this rank's query shard (rows in shard-local order; the
+    zigzag layout's rows are the rank's two stripes back to back)."""
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
-    q_start = rank * s_local
 
     run_out = jnp.zeros((b, h, s_local, d), jnp.float32)
     run_lse = jnp.full((b, h, s_local), _LSE_FLOOR, jnp.float32)
 
-    halves = _halves(s_local)
+    halves = _halves(s_local) if layout == "contiguous" else _zig_halves(s_local)
+    qblocks = _q_blocks(layout, rank, s_local, n)
     resident = {sign: (k[:, :, start:start + length],
                        v[:, :, start:start + length])
                 for start, length, sign in halves}
@@ -101,59 +203,69 @@ def _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k, interpret):
         for start, length, sign in halves:
             kh, vh = resident[sign]
             src = (rank - sign * hop) % n  # whose half is resident this hop
-            k_start = src * s_local + start
+            k_start = _kv_global_start(layout, src, start, s_local, n)
+            for q_row, q_len, q_gs in qblocks:
+                qb = q[:, :, q_row:q_row + q_len]
 
-            def compute(q, kh, vh, k_start=k_start):
-                o, l = flash_attention_lse(
-                    q, kh, vh, causal,
-                    q_start=q_start, k_start=k_start,
-                    block_q=block_q, block_k=block_k, interpret=interpret,
-                )
-                return o.astype(jnp.float32), l
+                def compute(qb, kh, vh, k_start=k_start, q_gs=q_gs):
+                    o, l = flash_attention_lse(
+                        qb, kh, vh, causal,
+                        q_start=q_gs, k_start=k_start,
+                        block_q=block_q, block_k=block_k, interpret=interpret,
+                    )
+                    return o.astype(jnp.float32), l
 
-            if causal and hop > 0:
-                # a source strictly later in the sequence is fully masked
-                # for every resident query row — skip the flash call (the
-                # MXU win; the block still rides the ring for later ranks)
-                o, l = lax.cond(
-                    src <= rank,
-                    compute,
-                    lambda q, kh, vh: (
-                        jnp.zeros((b, h, s_local, d), jnp.float32),
-                        jnp.full((b, h, s_local), _LSE_FLOOR, jnp.float32),
-                    ),
-                    q, kh, vh,
-                )
-            else:
-                o, l = compute(q, kh, vh)
-            run_out, run_lse = _merge(run_out, run_lse, o, l)
+                always, pred = _keep_pair(layout, causal, hop, src, rank,
+                                          k_start, q_gs, q_len)
+                if always:
+                    o, l = compute(qb, kh, vh)
+                else:
+                    # fully-masked pair: skip the flash call (the MXU
+                    # win; the block still rides the ring for others)
+                    o, l = lax.cond(
+                        pred,
+                        compute,
+                        lambda qb, kh, vh, _ql=q_len: (
+                            jnp.zeros((b, h, _ql, d), jnp.float32),
+                            jnp.full((b, h, _ql), _LSE_FLOOR, jnp.float32),
+                        ),
+                        qb, kh, vh,
+                    )
+                mo, ml = _merge(run_out[:, :, q_row:q_row + q_len],
+                                run_lse[:, :, q_row:q_row + q_len], o, l)
+                run_out = run_out.at[:, :, q_row:q_row + q_len].set(mo)
+                run_lse = run_lse.at[:, :, q_row:q_row + q_len].set(ml)
         if hop != n - 1:
             resident = {sign: ring_pass(kv, axis_name, sign)
                         for sign, kv in resident.items()}
     return run_out, run_lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring(q, k, v, axis_name, causal, block_q, block_k, interpret):
-    out, _ = _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring(q, k, v, axis_name, causal, block_q, block_k, interpret, layout):
+    out, _ = _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k,
+                            interpret, layout)
     return out.astype(q.dtype)
 
 
-def _ring_fwd_rule(q, k, v, axis_name, causal, block_q, block_k, interpret):
-    out, lse = _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k, interpret)
+def _ring_fwd_rule(q, k, v, axis_name, causal, block_q, block_k, interpret,
+                   layout):
+    out, lse = _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k,
+                              interpret, layout)
     # residuals are this rank's RESIDENTS only — O(S/cp), the whole point
     return out.astype(q.dtype), (q, k, v, out.astype(q.dtype), lse)
 
 
-def _ring_bwd_rule(axis_name, causal, block_q, block_k, interpret, res, g):
+def _ring_bwd_rule(axis_name, causal, block_q, block_k, interpret, layout,
+                   res, g):
     q, k, v, out, lse = res
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
-    q_start = rank * s_local
 
     dq = jnp.zeros((b, h, s_local, d), jnp.float32)
-    halves = _halves(s_local)
+    halves = _halves(s_local) if layout == "contiguous" else _zig_halves(s_local)
+    qblocks = _q_blocks(layout, rank, s_local, n)
     # per direction: (k_half, v_half, dk_acc, dv_acc) travel TOGETHER — each
     # visiting block accumulates every rank's contribution as it tours the
     # ring, then takes one final hop home to its owner
@@ -167,30 +279,39 @@ def _ring_bwd_rule(axis_name, causal, block_q, block_k, interpret, res, g):
         for start, length, sign in halves:
             kh, vh, dkh, dvh = state[sign]
             src = (rank - sign * hop) % n
-            k_start = src * s_local + start
+            k_start = _kv_global_start(layout, src, start, s_local, n)
+            for q_row, q_len, q_gs in qblocks:
+                qb = q[:, :, q_row:q_row + q_len]
+                ob = out[:, :, q_row:q_row + q_len]
+                lb = lse[:, :, q_row:q_row + q_len]
+                gb = g[:, :, q_row:q_row + q_len]
 
-            def grads(q, kh, vh, out, lse, g, k_start=k_start):
-                return flash_block_grads(
-                    q, kh, vh, out, lse, g, None, causal,
-                    q_start=q_start, k_start=k_start,
-                    block_q=block_q, block_k=block_k, interpret=interpret,
-                )
+                def grads(qb, kh, vh, ob, lb, gb, k_start=k_start, q_gs=q_gs):
+                    return flash_block_grads(
+                        qb, kh, vh, ob, lb, gb, None, causal,
+                        q_start=q_gs, k_start=k_start,
+                        block_q=block_q, block_k=block_k, interpret=interpret,
+                    )
 
-            if causal and hop > 0:
-                dq_p, dk_p, dv_p = lax.cond(
-                    src <= rank,
-                    grads,
-                    lambda q, kh, vh, out, lse, g, _l=length: (
-                        jnp.zeros((b, h, s_local, d), jnp.float32),
-                        jnp.zeros((b, h, _l, d), jnp.float32),
-                        jnp.zeros((b, h, _l, d), jnp.float32),
-                    ),
-                    q, kh, vh, out, lse, g,
-                )
-            else:
-                dq_p, dk_p, dv_p = grads(q, kh, vh, out, lse, g)
-            dq = dq + dq_p
-            state[sign] = (kh, vh, dkh + dk_p, dvh + dv_p)
+                always, pred = _keep_pair(layout, causal, hop, src, rank,
+                                          k_start, q_gs, q_len)
+                if always:
+                    dq_p, dk_p, dv_p = grads(qb, kh, vh, ob, lb, gb)
+                else:
+                    dq_p, dk_p, dv_p = lax.cond(
+                        pred,
+                        grads,
+                        lambda qb, kh, vh, ob, lb, gb, _l=length, _ql=q_len: (
+                            jnp.zeros((b, h, _ql, d), jnp.float32),
+                            jnp.zeros((b, h, _l, d), jnp.float32),
+                            jnp.zeros((b, h, _l, d), jnp.float32),
+                        ),
+                        qb, kh, vh, ob, lb, gb,
+                    )
+                dq = dq.at[:, :, q_row:q_row + q_len].add(dq_p)
+                dkh = dkh + dk_p
+                dvh = dvh + dv_p
+            state[sign] = (kh, vh, dkh, dvh)
         if hop != n - 1:
             state = {sign: ring_pass(s, axis_name, sign)
                      for sign, s in state.items()}
@@ -220,6 +341,7 @@ def ring_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Exact attention over a sequence sharded along ``axis_name`` (the
     ``cp`` mesh axis), one flash call per visiting KV half-block — call
@@ -232,13 +354,29 @@ def ring_attention(
     Any per-rank length works (odd residual blocks ride the flash kernel's
     padded path). Differentiable; parity to single-device flash pinned in
     tests.
+
+    ``layout="zigzag"`` interprets each rank's rows as its two
+    INTERLEAVED stripes (place them with :func:`zigzag_indices`; even
+    per-rank length required): causal skipping then load-balances — every
+    rank executes the same (2n+1)/4n of its pair grid instead of rank
+    n−1 running everything (the critical path halves at large cp).
+    Tokens/gradients stay exact under either layout.
     """
     if q.ndim != 4:
         raise ValueError(f"expected [batch, heads, seq, head_dim], got {q.shape}")
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(
+            f"layout must be 'contiguous' or 'zigzag', got {layout!r}"
+        )
     n = lax.axis_size(axis_name)
     if n == 1:
         return flash_attention(q, k, v, causal, block_q, block_k, interpret)
-    return _ring(q, k, v, axis_name, causal, block_q, block_k, interpret)
+    if layout == "zigzag" and q.shape[2] % 2:
+        raise ValueError(
+            f"zigzag needs an even per-rank length, got {q.shape[2]}"
+        )
+    return _ring(q, k, v, axis_name, causal, block_q, block_k, interpret,
+                 layout)
 
 
 def ring_kv_wire_bytes(
@@ -274,13 +412,34 @@ def ring_kv_wire_bytes(
     return (n_ranks - 1) * (kv_hop + dkv_hop) + dkv_hop
 
 
-def causal_keep_fraction(n_ranks: int) -> float:
-    """Fraction of the hop grid causal skipping still EXECUTES: rank r runs
-    r+1 of the n forward-direction hops and 1+r of the n backward-direction
-    hops, so Σ(2r+2) / 2n² = (n+1)/(2n) — asymptotically the causal-mask 2×,
-    realized at the schedule level instead of inside a masked kernel. The
-    docs/TUNING.md savings table is generated from this."""
+def causal_keep_fraction(n_ranks: int, layout: str = "contiguous") -> float:
+    """MEAN fraction of the hop grid causal skipping still executes.
+    Contiguous: rank r runs r+1 of the n forward-direction hops and 1+r
+    of the n backward-direction hops, so Σ(2r+2) / 2n² = (n+1)/(2n) —
+    asymptotically the causal-mask 2×, realized at the schedule level.
+    Zigzag: every rank executes exactly (2n+1) of its 4n stripe pairs —
+    the SAME asymptotic mean, but constant per rank (see
+    :func:`causal_critical_path_fraction`). The docs/TUNING.md savings
+    table is generated from this."""
     n = int(n_ranks)
     if n <= 1:
         return 1.0
+    if layout == "zigzag":
+        return (2 * n + 1) / (4 * n)
     return (n + 1) / (2 * n)
+
+
+def causal_critical_path_fraction(n_ranks: int,
+                                  layout: str = "contiguous") -> float:
+    """The SLOWEST rank's executed fraction — what actually bounds wall
+    time, since every rank waits at the ring barrier. Contiguous: rank
+    n−1 executes its whole grid (1.0 — causal skipping saves mean MXU
+    time, not wall time). Zigzag: per-rank work is constant, so the
+    critical path IS the mean (2n+1)/4n → ~½ at large cp — the zigzag
+    layout's ~2× wall win."""
+    n = int(n_ranks)
+    if n <= 1:
+        return 1.0
+    if layout == "zigzag":
+        return (2 * n + 1) / (4 * n)
+    return 1.0

@@ -700,13 +700,67 @@ def _paged_serving_smoke(model, cfg, rng) -> dict:
         used = (w.used_pages if hasattr(w, "used_pages")
                 else w._pages.used_pages)
         leaked += max(used - base, 0)
-    return {
+    report = {
         "requests": len(prompts),
         "token_mismatches": token_loss,
         "ticks": tick,
         "requeued_decode": router.requeued_decode,
         "peak_shared_pages": peak_shared,
         "leaked_pages": leaked,
+    }
+    report.update(_paged_eviction_leg(model, cfg, rng))
+    return report
+
+
+def _paged_eviction_leg(model, cfg, rng) -> dict:
+    """The EVICTION leg (preemption=True, docs/SERVING.md § Paged KV): a
+    pool far too small for the worst case forces mid-decode preemptions —
+    the lowest-priority slot's pages swap out (or drop for recompute) and
+    the request resumes when pages free. Invariants: every PREEMPTED
+    request re-emits tokens identical to the uncontended big-pool run
+    (preemption is pure scheduling), and the drained pool is back to
+    empty — zero page leaks."""
+    from dsml_tpu.serving import ContinuousBatcher
+
+    params = model.init(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, l).astype(np.int32)
+        for l in (17, 9, 13)
+    ]
+    budgets = [12, 12, 10]
+    ref = ContinuousBatcher(model, params, n_slots=3, prefill_chunk=8,
+                            paged_kv="int4", page_size=8, n_pages=40)
+    ref_rids = [ref.submit(p, n) for p, n in zip(prompts, budgets)]
+    got = ref.run()
+    ref_tokens = [got[r] for r in ref_rids]
+
+    srv = ContinuousBatcher(model, params, n_slots=3, prefill_chunk=8,
+                            paged_kv="int4", page_size=8, n_pages=8,
+                            preemption=True)
+    preempted_rids: set = set()
+    evict = srv._evict_slot
+
+    def spy(slot):
+        preempted_rids.add(int(srv._slot_rid[slot]))
+        evict(slot)
+
+    srv._evict_slot = spy
+    rids = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+    out = srv.run()
+    mismatches = sum(
+        1 for rid, want in zip(rids, ref_tokens) if out.get(rid) != want
+    )
+    resumed_ok = sum(
+        1 for rid, want in zip(rids, ref_tokens)
+        if rid in preempted_rids and out.get(rid) == want
+    )
+    return {
+        "eviction_preemptions": srv.n_preemptions,
+        "eviction_swap": srv.n_swap_evictions,
+        "eviction_recompute": srv.n_recompute_evictions,
+        "eviction_resumed_identical": resumed_ok,
+        "eviction_token_mismatches": mismatches,
+        "eviction_leaked_pages": srv.n_pages - 1 - srv.free_pages,
     }
 
 
@@ -1156,6 +1210,27 @@ def verify(report: dict) -> list[str]:
                 f"serving_paged: {paged['leaked_pages']} pool page(s) "
                 "leaked past request retirement (the dead worker's pages "
                 "must reclaim without shrinking pool capacity)"
+            )
+        if not paged.get("eviction_preemptions"):
+            bad.append(
+                "serving_paged: the eviction leg forced no preemption — "
+                "the swap/resume path went unexercised"
+            )
+        if not paged.get("eviction_resumed_identical"):
+            bad.append(
+                "serving_paged: no preempted request resumed with the "
+                "reference tokens — eviction must be pure scheduling"
+            )
+        if paged.get("eviction_token_mismatches", 0) > 0:
+            bad.append(
+                f"serving_paged: {paged['eviction_token_mismatches']} "
+                "request(s) changed tokens across an eviction/resume"
+            )
+        if paged.get("eviction_leaked_pages", 0) > 0:
+            bad.append(
+                f"serving_paged: {paged['eviction_leaked_pages']} page(s) "
+                "leaked through the preemption tier (swap-out must "
+                "release every reference it takes)"
             )
     return bad
 

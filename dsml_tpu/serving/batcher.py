@@ -89,6 +89,11 @@ class Request:
     # decode-side spans/flow events and the admission-histogram exemplar
     # all tag with it, so a tail latency resolves to ONE request's trace
     trace_id: str | None = None
+    # preemption priority (paged ``preemption=True`` only): under page
+    # pressure the LOWEST-priority active slot is evicted first (ties
+    # break youngest-first, so FIFO order degrades last). Pure
+    # scheduling — tokens never depend on it.
+    priority: int = 0
 
     def trace_ctx(self):
         """The request's TraceContext (flow id derives from trace_id
@@ -254,13 +259,27 @@ class ContinuousBatcher:
         paged_kv=False,
         page_size: int = 16,
         n_pages: int = 0,
+        preemption: bool = False,
+        preempt_policy: str = "auto",
     ):
         """``mesh`` — a framework mesh (``parallel.mesh.build_mesh``) makes
         serving TENSOR-PARALLEL: params are Megatron-sharded
-        (``model.param_specs()``), the slot cache's head axis shards over
-        'tp', and prefill/decode run head-parallel under shard_map with the
-        full logits row reconstructed for sampling — same tokens as the
-        single-device batcher (tests pin it)."""
+        (``model.param_specs()``), the slot cache's (or page pool's) head
+        axis shards over 'tp', and prefill/decode run head-parallel under
+        shard_map with the full logits row reconstructed for sampling —
+        same tokens as the single-device batcher (tests pin it).
+
+        ``preemption`` (paged only) — replace up-front worst-case page
+        reservation with an eviction tier: admission reserves only the
+        prompt chunk grid, decode GROWS the allocation page-by-page, and
+        when growth finds the pool dry the lowest-priority active slot is
+        preempted — its private pages swap to host (the handoff page
+        payload layout) or drop for recompute-from-prompt per
+        ``preempt_policy`` ("swap" | "recompute" | "auto") — and the
+        request resumes, tokens identical, once pages free. CoW-shared
+        prefix pages are never evicted while shared (the refcount keeps
+        the master alive; the victim only drops its reference).
+        docs/SERVING.md § Paged KV has the policy rule."""
         cfg = model.config
         self.model = model
         self.mesh = mesh
@@ -296,12 +315,17 @@ class ContinuousBatcher:
                            else model._page_mode(paged_kv))  # None|int8|int4
         self.paged = bool(paged_kv)
         self.page_size = int(page_size)
+        if preemption and not self.paged:
+            raise ValueError("preemption is a paged_kv eviction tier; "
+                             "set paged_kv=")
+        if preempt_policy not in ("swap", "recompute", "auto"):
+            raise ValueError(
+                f"preempt_policy must be 'swap', 'recompute', or 'auto', "
+                f"got {preempt_policy!r}"
+            )
+        self.preemption = bool(preemption)
+        self.preempt_policy = preempt_policy
         if self.paged:
-            if mesh is not None:
-                raise ValueError(
-                    "paged_kv is single-device (the dense cache carries the "
-                    "TP serving path); drop mesh= or paged_kv="
-                )
             if turbo_factor or adaptive_quantum:
                 raise ValueError(
                     "paged_kv composes with plain decode quanta and "
@@ -325,6 +349,18 @@ class ContinuousBatcher:
             # everything unallocated; device copy rides along per dispatch
             self._page_table = np.zeros((n_slots, self._n_pt), np.int32)
             self._slot_pages: list[list] = [[] for _ in range(n_slots)]
+            # per-slot CoW accounting + preemption priority: the first
+            # _slot_shared[s] entries of a slot's page list are read-only
+            # shared prefix pages (never swapped — only the reference is
+            # dropped on eviction); _slot_prio orders eviction victims
+            self._slot_shared = np.zeros(n_slots, np.int32)
+            self._slot_prio = np.zeros(n_slots, np.int64)
+            # preempted-but-unfinished requests awaiting resume (FIFO;
+            # resumes take precedence over fresh admissions)
+            self._preempted: deque = deque()
+            self.n_preemptions = 0
+            self.n_swap_evictions = 0
+            self.n_recompute_evictions = 0
             # flow marks dedupe per wait EPISODE (rid of the last blocked
             # head per queue) — the counter stays per-tick, but marking
             # every blocked tick would flood a stuck request's trace chain
@@ -508,7 +544,7 @@ class ContinuousBatcher:
                 def body(carry, i):
                     pool, t, pos = carry
                     logits, pool = model.decode_step_slots_paged(
-                        p, pool, table, t, pos, None, pq
+                        p, pool, table, t, pos, tp_axis, pq
                     )
                     if temperature <= 0.0:
                         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -598,37 +634,86 @@ class ContinuousBatcher:
 
         if self.paged:
             pq = self.page_quant
-            self.params = params
-            self._pool = model.init_page_pool(
-                self.n_pages, self.page_size, quant=pq
-            )
-            # the pool is donated every dispatch, exactly like the dense
-            # cache: XLA updates the page buffers in place
-            self._decode_paged = jax.jit(
-                make_decode_k_paged(decode_quantum), donate_argnums=(1,)
-            )
 
             def chunk_paged_fn(p, pool, table, toks, start, last):
                 return model.prefill_chunk_paged(
-                    p, pool, table, toks, start, None, last_index=last,
+                    p, pool, table, toks, start, tp_axis, last_index=last,
                     quant=pq,
                 )
 
-            self._prefill_chunk_paged = jax.jit(
-                chunk_paged_fn, donate_argnums=(1,)
-            )
-
             def verify_paged_fn(p, pool, table, toks, pos):
                 return model.verify_step_paged(
-                    p, pool, table, toks, pos, None, quant=pq
+                    p, pool, table, toks, pos, tp_axis, quant=pq
                 )
 
-            # jit retraces per window width, so ONE program object serves
-            # the adaptive ladder (each width compiles once)
-            self._verify_paged = jax.jit(verify_paged_fn, donate_argnums=(1,))
+            if mesh is None:
+                self.params = params
+                self._pool = model.init_page_pool(
+                    self.n_pages, self.page_size, quant=pq
+                )
+                # the pool is donated every dispatch, exactly like the
+                # dense cache: XLA updates the page buffers in place
+                self._decode_paged = jax.jit(
+                    make_decode_k_paged(decode_quantum), donate_argnums=(1,)
+                )
+                self._prefill_chunk_paged = jax.jit(
+                    chunk_paged_fn, donate_argnums=(1,)
+                )
+                # jit retraces per window width, so ONE program object
+                # serves the adaptive ladder (each width compiles once)
+                self._verify_paged = jax.jit(
+                    verify_paged_fn, donate_argnums=(1,)
+                )
+            else:
+                # TP paged serving: the pool's HEAD axis shards over 'tp'
+                # (the dense cache's sharding rule, applied to pages);
+                # the page/row axes replicate their index math across
+                # shards, so the page table, allocator, and host
+                # scheduler are untouched — a multi-chip decode replica
+                # gets the paged capacity win per chip
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from dsml_tpu.parallel.hybrid import shard_params
+
+                tp_size = mesh.shape.get("tp", 1)
+                n_kv = getattr(cfg, "n_kv_head", cfg.n_head)
+                if n_kv % tp_size:
+                    raise ValueError(
+                        f"pool head count {n_kv} not divisible by tp={tp_size}"
+                    )
+                pspecs = model.param_specs()
+                self.params = shard_params(params, mesh, pspecs)
+                pool_global = model.init_page_pool(
+                    self.n_pages, self.page_size, quant=pq
+                )
+                head_sh = NamedSharding(mesh, P(None, "tp"))
+                self._pool = jax.tree.map(
+                    lambda a: jax.device_put(a, head_sh), pool_global
+                )
+                pool_spec = jax.tree.map(lambda _: P(None, "tp"), pool_global)
+
+                def _tp_paged_jit(fn, n_rep):
+                    return jax.jit(
+                        jax.shard_map(
+                            fn, mesh=mesh,
+                            in_specs=(pspecs, pool_spec) + (P(),) * n_rep,
+                            out_specs=(P(), pool_spec),
+                            check_vma=False,
+                        ),
+                        donate_argnums=(1,),
+                    )
+
+                self._decode_paged = _tp_paged_jit(
+                    make_decode_k_paged(decode_quantum), 5
+                )
+                self._prefill_chunk_paged = _tp_paged_jit(chunk_paged_fn, 4)
+                self._verify_paged = _tp_paged_jit(verify_paged_fn, 3)
 
             from dsml_tpu.serving.paging import copy_page
 
+            # page copy / handoff install stay PLAIN jits: index-space
+            # ops along the page axis, which GSPMD shards per-head for
+            # free when the pool carries a tp sharding
             self._copy_page = jax.jit(copy_page, donate_argnums=(0,))
 
             def install_pages_fn(pool, payload, phys):
@@ -642,6 +727,12 @@ class ContinuousBatcher:
             self._install_pages = jax.jit(
                 install_pages_fn, donate_argnums=(0,)
             )
+            # pool occupancy gauges refresh at SCRAPE time (the collect
+            # hook), not per tick: an idle batcher's /metrics must show
+            # the pool's CURRENT state, not freeze at the last tick's
+            # (the frozen-SLO-burn-gauge bug class; weakly held — the
+            # hook dies with this batcher)
+            self._obs.add_collect_hook(self._export_pool_gauges)
         elif mesh is None:
             self.params = params
             self._cache = model.init_cache(n_slots)
@@ -827,7 +918,8 @@ class ContinuousBatcher:
 
     def submit(self, prompt, max_new_tokens: int,
                key_rid: int | None = None,
-               trace_id: str | None = None) -> int:
+               trace_id: str | None = None,
+               priority: int = 0) -> int:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("empty prompt")
@@ -865,7 +957,8 @@ class ContinuousBatcher:
             # that could only livelock at the FIFO head must fail HERE
             pre = self._prefixes and self._match_prefix(prompt)
             p_len = len(pre[0]) if pre else 0
-            need = self._reserve_rows(len(prompt), max_new_tokens, p_len)
+            need = self._reserve_rows(len(prompt), max_new_tokens, p_len,
+                                      worst_case=True)
             n_private = -(-need // self.page_size) - p_len // self.page_size
             ceiling = self.n_pages - 1 - self._registry_pages
             if n_private > ceiling:
@@ -885,7 +978,7 @@ class ContinuousBatcher:
         self._next_rid += 1
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
                       submitted_at=time.monotonic(), key_rid=key_rid,
-                      trace_id=trace_id)
+                      trace_id=trace_id, priority=int(priority))
         self._queue.append(req)
         self._live[rid] = req
         return rid
@@ -976,7 +1069,7 @@ class ContinuousBatcher:
                 self._registered_prefix_pages(prompt, prefix_rows)
             n_ship = int(kv_pages[0]["k"].shape[0])
             rows = self._handoff_rows(len(prompt), max_new_tokens,
-                                      prefix_rows, n_ship)
+                                      prefix_rows, n_ship, worst_case=True)
             n_private = (-(-rows // self.page_size)
                          - prefix_rows // self.page_size)
             ceiling = self.n_pages - 1 - self._registry_pages
@@ -1077,6 +1170,11 @@ class ContinuousBatcher:
             private = self._pages.alloc(n_private)
             self._inject.popleft()
             self._slot_pages[slot] = shared + private
+            # the CoW boundary must ride along: eviction treats the first
+            # _slot_shared entries as reference-only (never swapped), so an
+            # injected slot without it would swap out REGISTRY pages and
+            # resume as if they were its own private allocation
+            self._slot_shared[slot] = len(shared)
             self._page_table[slot, :] = 0
             self._page_table[slot, : len(shared) + len(private)] = shared + private
             if n_ship:
@@ -1218,6 +1316,12 @@ class ContinuousBatcher:
         — a fourth drain-loop term alongside queued/active/pending."""
         return len(self._inject)
 
+    @property
+    def n_preempted(self) -> int:
+        """Evicted-but-unfinished requests awaiting resume (the paged
+        ``preemption`` tier) — the fifth drain-loop term; 0 elsewhere."""
+        return len(self._preempted) if (self.paged and self.preemption) else 0
+
     # ---- scheduling ------------------------------------------------------------
 
     def _request_key(self, rid: int):
@@ -1256,36 +1360,53 @@ class ContinuousBatcher:
         return -(-prompt_len // c) * c <= self.model.config.max_seq
 
     def _handoff_rows(self, prompt_len: int, max_new: int, prefix_rows: int,
-                      n_ship: int) -> int:
+                      n_ship: int, worst_case: bool = False) -> int:
         """Rows a paged HANDOFF admission must reserve pages for: the
         decode budget (+ speculative overhang) or the shipped+shared page
         grid, whichever is larger — THE one formula, shared by inject's
         capacity validation and the actual admission reservation so the
-        two can never disagree."""
+        two can never disagree. With ``preemption`` the admission
+        reserves only the landing grid (shipped + shared pages) and the
+        decode budget grows page-by-page."""
         base = prompt_len + max_new
         if self.speculative_window:
             base += self.speculative_window - 1
-        return max(base, prefix_rows + n_ship * self.page_size)
+        landing = prefix_rows + n_ship * self.page_size
+        if self.preemption and not worst_case:
+            return max(prompt_len, landing)
+        return max(base, landing)
 
     def _reserve_rows(self, prompt_len: int, max_new: int,
-                      prefix_len: int) -> int:
+                      prefix_len: int, worst_case: bool = False) -> int:
         """Rows a paged admission must reserve pages for — everything the
         request can EVER write: the padded prefill chunk grid (pad rows of
         the final chunk land in pages too), the decode budget, and the
         speculative verify window's overhang. Reserving up front is what
-        makes decode/verify allocation-free mid-flight (docs/SERVING.md)."""
+        makes decode/verify allocation-free mid-flight (docs/SERVING.md).
+
+        With ``preemption`` only the CHUNK GRID reserves (what prefill
+        itself writes); the decode budget and verify overhang grow
+        page-by-page under ``_ensure_decode_pages``, and pressure evicts
+        instead of deadlocking — admission tracks current demand, not the
+        worst case. ``worst_case=True`` (submit's never-fits check)
+        always returns the full footprint: eviction cannot shrink ONE
+        request's own eventual live set, so a request whose footprint
+        exceeds the reservable ceiling must still fail at submit."""
         base = prompt_len + max_new
         if self.speculative_window:
             base += self.speculative_window - 1
         c = self.prefill_chunk or self.page_size
         grid_end = prefix_len + -(-(prompt_len - prefix_len) // c) * c \
             if prompt_len > prefix_len else prompt_len
+        if self.preemption and not worst_case:
+            return min(self.model.config.max_seq, grid_end)
         return min(self.model.config.max_seq, max(base, grid_end))
 
     def _assign_slot_pages(self, slot: int, plan) -> None:
         """Install an admission plan's pages as ``slot``'s page table (and
         run its CoW straddle copy, counting it)."""
         self._slot_pages[slot] = list(plan.pages)
+        self._slot_shared[slot] = plan.n_shared
         self._page_table[slot, :] = 0
         self._page_table[slot, : len(plan.pages)] = plan.pages
         if plan.copy is not None:
@@ -1321,7 +1442,215 @@ class ContinuousBatcher:
         if pages:
             self._pages.release(pages)
         self._slot_pages[slot] = []
+        self._slot_shared[slot] = 0
         self._page_table[slot, :] = 0
+
+    # ---- eviction-based preemption (paged preemption=True) ---------------------
+
+    def _pick_victim(self, exclude: int | None = None) -> int | None:
+        """The eviction order: lowest priority first, youngest (highest
+        rid) within a priority — FIFO fairness degrades last. ``exclude``
+        shields the slot whose growth triggered the pressure (it preempts
+        itself only when nothing else is left)."""
+        best = None
+        for slot in np.flatnonzero(self._slot_rid >= 0):
+            s = int(slot)
+            if s == exclude:
+                continue
+            key = (self._slot_prio[s], -self._slot_rid[s])
+            if best is None or key < best[0]:
+                best = (key, s)
+        return None if best is None else best[1]
+
+    def _preempt_kind(self, req) -> str:
+        """The swap-vs-recompute rule (docs/SERVING.md § Paged KV).
+        "auto": a victim still at its first token holds only prompt-grid
+        pages that chunked prefill reproduces at full throughput (and may
+        re-hit the prefix cache) — RECOMPUTE, skip the host round trip;
+        past that, swapping the live bytes beats re-running prefill over
+        prompt + emitted rows. Both paths resume with identical tokens
+        (quantized chunk chaining is chunk-size-invariant, so recomputed
+        rows are bit-identical to the evicted ones — the PR 11 property
+        the recompute path rests on)."""
+        if self.preempt_policy != "auto":
+            return self.preempt_policy
+        return "recompute" if len(req.tokens) <= 1 else "swap"
+
+    def _evict_slot(self, slot: int) -> None:
+        """Preempt ``slot``: private pages swap to host (the handoff page
+        payload layout — ``paging.gather_pages``) or drop for recompute,
+        ALL page references release (a CoW-shared prefix page just loses
+        this reference; the refcount keeps the registry master alive —
+        shared pages are NEVER evicted while shared), and the request
+        joins the resume queue. The consumer sees a longer inter-emission
+        gap, never different tokens."""
+        from dsml_tpu.serving.paging import gather_pages
+
+        req = self._live[int(self._slot_rid[slot])]
+        pages = self._slot_pages[slot]
+        n_shared = int(self._slot_shared[slot])
+        private = pages[n_shared:]
+        kind = self._preempt_kind(req)
+        entry = {
+            "req": req,
+            "pos": int(self._pos[slot]),
+            "last_tok": int(self._last_tok[slot]),
+            "shared_rows": n_shared * self.page_size,
+            "kind": kind,
+        }
+        if kind == "swap":
+            entry["pages_host"] = gather_pages(self._pool, private)
+            self.n_swap_evictions += 1
+        else:
+            self.n_recompute_evictions += 1
+        self._slot_rid[slot] = -1
+        self._free_slot_pages(slot)
+        self._preempted.append(entry)
+        self.n_preemptions += 1
+        if self._obs.enabled:
+            from dsml_tpu.obs import flight_recorder
+
+            self._obs.counter(
+                "serving_preemptions_total",
+                "slots evicted under page-pool pressure",
+                labels=("kind", "replica", "role"),
+            ).inc(kind=kind, replica=self.obs_replica, role=self.obs_role)
+            extra = {"trace_id": req.trace_id} if req.trace_id else {}
+            flight_recorder.record(
+                "serving_preempt", rid=req.rid, kind=kind,
+                pos=entry["pos"], **extra,
+            )
+
+    def _ensure_decode_pages(self, active, width: int):
+        """Preemption-mode page GROWTH: before a decode/verify dispatch,
+        every participating slot must own pages covering its next
+        ``width`` write rows. When the pool is dry, evict (lowest
+        priority, youngest first) until the growth fits — the growing
+        slot itself is preempted only when no other victim remains.
+        Returns the slots still active (victims drop out); non-preemption
+        batchers pass through untouched (their reservation covered
+        everything up front)."""
+        if not (self.paged and self.preemption):
+            return active
+        max_seq = self.model.config.max_seq
+        kept = []
+        for slot in active:
+            s = int(slot)
+            if self._slot_rid[s] < 0:
+                continue  # already evicted as a victim this pass
+            last_row = min(int(self._pos[s]) + width - 1, max_seq - 1)
+            n_entries = last_row // self.page_size + 1
+            while len(self._slot_pages[s]) < n_entries:
+                want = n_entries - len(self._slot_pages[s])
+                if self._pages.can_alloc(want):
+                    start_i = len(self._slot_pages[s])
+                    new = self._pages.alloc(want)
+                    self._slot_pages[s].extend(new)
+                    self._page_table[s, start_i : start_i + want] = new
+                    continue
+                victim = self._pick_victim(exclude=s)
+                if victim is None:
+                    # nothing else holds pages: this slot yields and
+                    # resumes when retirements free the pool (submit's
+                    # worst-case never-fits check guarantees it CAN)
+                    self._evict_slot(s)
+                    break
+                self._evict_slot(int(victim))
+            if self._slot_rid[s] >= 0:
+                kept.append(s)
+        return [s for s in kept if self._slot_rid[s] >= 0]
+
+    def _try_resume(self, entry: dict, slot: int) -> bool:
+        """Re-admit one preempted request into ``slot``. Swap: re-share
+        the registered prefix pages, allocate fresh private pages, land
+        the host copy verbatim (the handoff install scatter), restore the
+        decode state — bit-identical rows, zero recompute. Recompute:
+        reserve the re-prefill grid and stage a pending chunked admission
+        over prompt + emitted tokens (all but the last, which is the next
+        decode input) — chunk-size invariance makes the rebuilt rows
+        bit-identical to the evicted ones. Returns False when the pool
+        cannot serve the resume yet (it keeps its queue spot; resumes
+        precede fresh admissions)."""
+        from dsml_tpu.serving.paging import pages_for
+
+        req = entry["req"]
+        pos = entry["pos"]
+        shared_rows = entry["shared_rows"]
+        n_full = shared_rows // self.page_size
+        if entry["kind"] == "swap":
+            payload = entry["pages_host"]
+            n_private = int(payload[0]["k"].shape[0])
+            if not self._pages.can_alloc(n_private):
+                return False
+            shared = (self._registered_prefix_pages(req.prompt, shared_rows)
+                      if shared_rows else [])
+            self._pages.share(shared)
+            private = self._pages.alloc(n_private)
+            pages = shared + private
+            self._slot_pages[slot] = pages
+            self._slot_shared[slot] = n_full
+            self._page_table[slot, :] = 0
+            self._page_table[slot, : len(pages)] = pages
+            if n_private:
+                payload_dev = [
+                    {key: jnp.asarray(arr) for key, arr in layer.items()}
+                    for layer in payload
+                ]
+                self.n_insert_dispatches += 1
+                self._pool = self._install_pages(
+                    self._pool, payload_dev,
+                    jnp.asarray(private, jnp.int32),
+                )
+            self._restore_slot(req, slot, pos, entry["last_tok"])
+            return True
+        # recompute: re-prefill prompt + tokens[:-1] (rows [0, pos)) from
+        # the shared prefix boundary; the final chunk's logits are
+        # discarded — the request already emitted its next input token
+        c = self.prefill_chunk or self.page_size
+        grid_end = shared_rows + -(-(pos - shared_rows) // c) * c
+        grid_end = min(grid_end, self.model.config.max_seq)
+        n_private = pages_for(grid_end, self.page_size) - n_full
+        if not self._pages.can_alloc(n_private):
+            return False
+        shared = (self._registered_prefix_pages(req.prompt, shared_rows)
+                  if shared_rows else [])
+        self._pages.share(shared)
+        private = self._pages.alloc(n_private)
+        pages = shared + private
+        self._slot_pages[slot] = pages
+        self._slot_shared[slot] = n_full
+        self._page_table[slot, :] = 0
+        self._page_table[slot, : len(pages)] = pages
+        if pos == shared_rows:
+            # every written row lives in shared registry pages (an
+            # exact-hit admission evicted before writing): nothing to
+            # recompute — reoccupy directly
+            self._restore_slot(req, slot, pos, entry["last_tok"])
+            return True
+        seq = np.concatenate(
+            [req.prompt, np.asarray(req.tokens[:-1], np.int32)]
+        )
+        assert len(seq) == pos, (len(seq), pos)
+        self._slot_rid[slot] = -2  # reserved: not free, not decoding
+        self._pending = (req, slot, shared_rows, seq,
+                         {"pos": pos, "last_tok": entry["last_tok"]})
+        return True
+
+    def _restore_slot(self, req, slot: int, pos: int, last_tok: int) -> None:
+        """Reoccupy ``slot`` with a resumed request's decode state (no
+        emission, no first-token sample — those already happened)."""
+        self._slot_rid[slot] = req.rid
+        self._pos[slot] = pos
+        self._last_tok[slot] = last_tok
+        self._slot_key[slot] = np.asarray(self._req_key(req))
+        self._slot_accept[slot] = np.nan
+        self._slot_prio[slot] = req.priority
+        if self._obs.enabled:
+            from dsml_tpu.obs import flight_recorder
+
+            extra = {"trace_id": req.trace_id} if req.trace_id else {}
+            flight_recorder.record("serving_resume", rid=req.rid, pos=pos,
+                                   **extra)
 
     @property
     def free_pages(self) -> int:
@@ -1342,6 +1671,8 @@ class ContinuousBatcher:
         self._last_tok[slot] = tok
         self._slot_key[slot] = np.asarray(self._req_key(req))
         self._slot_accept[slot] = np.nan  # a fresh request, a fresh EWMA
+        if self.paged:
+            self._slot_prio[slot] = req.priority
 
     def _finish_admission(self, req: Request, slot: int, logits_row, emitted: dict) -> None:
         """THE admission epilogue — shared by whole-prompt, chunked, and
@@ -1504,7 +1835,29 @@ class ContinuousBatcher:
                     return emitted  # long admission mid-flight: decode now
                 continue
             free = np.flatnonzero(self._slot_rid == -1)
-            if len(free) == 0 or not self._queue:
+            if len(free) == 0:
+                return emitted
+            if self.preemption and self._preempted:
+                # resumes precede fresh admissions: a preempted request
+                # already paid its prefill (and its queue wait) — parking
+                # it behind new work would turn one eviction into
+                # unbounded starvation. A resume that cannot reserve yet
+                # holds the line (FIFO; retirements free pages).
+                if not self._try_resume(self._preempted[0], int(free[0])):
+                    from dsml_tpu.serving.paging import note_page_wait
+
+                    rid = self._preempted[0]["req"].rid
+                    first = self._page_wait_rid_queue != rid
+                    self._page_wait_rid_queue = rid
+                    note_page_wait(
+                        self._obs, self.obs_replica, self.obs_role,
+                        trace=(self._preempted[0]["req"].trace_ctx()
+                               if first else None),
+                    )
+                    return emitted
+                self._preempted.popleft()
+                continue
+            if not self._queue:
                 return emitted
             req = self._queue[0]  # peek: pop only once pages are reserved
             L = len(req.prompt)
@@ -1546,19 +1899,23 @@ class ContinuousBatcher:
                 self._finish_admission(req, slot, plogits, emitted)
                 continue
             self._slot_rid[slot] = -2  # reserve: not free, not decoding
-            self._pending = (req, slot, p_len)
+            self._pending = (req, slot, p_len, req.prompt, None)
 
     def _advance_pending_paged(self, emitted: dict) -> bool:
         """Run ONE chunk of the in-flight paged admission — the chunk
         writes straight into the slot's reserved pool pages (no side
         cache, no final insert dispatch). Returns True when the admission
-        completed this call."""
-        req, slot, start = self._pending
-        c = self.prefill_chunk
-        L = len(req.prompt)
+        completed this call. ``seq`` is the row stream being prefilled —
+        the prompt for a fresh admission, prompt + emitted tokens for a
+        recompute RESUME (``resume`` then carries the decode state to
+        restore; the final chunk's logits are discarded — the resumed
+        request already sampled its next input)."""
+        req, slot, start, seq, resume = self._pending
+        c = self.prefill_chunk or self.page_size
+        L = len(seq)
         end = min(start + c, L)
         padded = np.zeros((1, c), np.int32)
-        padded[0, : end - start] = req.prompt[start:end]
+        padded[0, : end - start] = seq[start:end]
         is_last = end >= L
         last_local = (L - 1) - start if is_last else c - 1
         table_row = jnp.asarray(self._page_table[slot : slot + 1])
@@ -1568,9 +1925,12 @@ class ContinuousBatcher:
         )
         self.n_prefill_dispatches += 1
         if not is_last:
-            self._pending = (req, slot, start + c)
+            self._pending = (req, slot, start + c, seq, resume)
             return False
         self._pending = None
+        if resume is not None:
+            self._restore_slot(req, slot, resume["pos"], resume["last_tok"])
+            return True
         self._finish_admission(req, slot, logits[0], emitted)
         return True
 
@@ -1693,15 +2053,24 @@ class ContinuousBatcher:
                 labels=("replica", "role"),
             ).inc(sum(len(t) for t in emitted.values()),
                   replica=self.obs_replica, role=self.obs_role)
-            if self.paged:
-                # pool occupancy + free-list gauges: the capacity signal
-                # behind "should this deployment raise n_pages" and the
-                # live CoW sharing the prefix registry is buying
-                from dsml_tpu.serving.paging import export_pool_gauges
-
-                export_pool_gauges(self._obs, self._pages,
-                                   self.obs_replica, self.obs_role)
+            # pool occupancy gauges are NOT exported here: they refresh
+            # at scrape time via the collect hook registered at
+            # construction (_export_pool_gauges) — a per-tick export
+            # would freeze an idle batcher's pool metrics at the last
+            # tick's values (the frozen-SLO-burn-gauge bug class)
         return emitted
+
+    def _export_pool_gauges(self) -> None:
+        """Collect-hook body: the (replica, role)-labeled pool
+        occupancy/free-list/CoW gauges, computed from the pool's CURRENT
+        state at every exposition (``Registry.add_collect_hook``) —
+        /metrics between ticks shows live occupancy, and an idle
+        batcher's gauges can never freeze. Reads ``obs_replica`` at call
+        time, so a fleet's restamp after spawn is reflected."""
+        from dsml_tpu.serving.paging import export_pool_gauges
+
+        export_pool_gauges(self._obs, self._pages,
+                           self.obs_replica, self.obs_role)
 
     def _step_inner(self) -> dict[int, list]:
         emitted: dict[int, list] = {}
@@ -1720,6 +2089,12 @@ class ContinuousBatcher:
             return emitted
         if self.speculative_window:
             return self._step_speculative(emitted, active)
+        if self.paged and self.preemption:
+            # lazy growth: every decoding slot must own pages for this
+            # tick's writes; pressure evicts (the slots list shrinks)
+            active = self._ensure_decode_pages(active, self.decode_quantum)
+            if not active:
+                return emitted
         steps_done = np.asarray(
             [len(self._live[rid].tokens) if rid >= 0 else 0 for rid in self._slot_rid],
             np.int32,
@@ -1888,6 +2263,11 @@ class ContinuousBatcher:
         per slot here — the adaptive window and the router's TPOT cost
         model both feed on them."""
         w = self._spec_window_for_tick()
+        if self.paged and self.preemption:
+            # the verify window writes rows pos..pos+w-1 — grow first
+            active = self._ensure_decode_pages(active, w)
+            if len(active) == 0:
+                return emitted
         toks = np.zeros((self.n_slots, w), np.int32)
         pos = np.zeros(self.n_slots, np.int32)
         for slot in active:
@@ -1993,6 +2373,10 @@ class ContinuousBatcher:
         #                       router re-prefills from the prompt
         self._live.clear()
         self._pending = None
+        if self.paged and self.preemption:
+            # preempted requests' pages released at eviction; their host
+            # swap copies die with the replica — re-prefill reproduces
+            self._preempted.clear()
         self._slot_rid[:] = -1
         self._pos[:] = 0
         self._last_tok[:] = 0
@@ -2042,7 +2426,8 @@ class ContinuousBatcher:
         retired during (or before) this call."""
         for _ in range(max_steps):
             if (not self._queue and not self._inject
-                    and self.n_active == 0 and self.n_pending == 0):
+                    and self.n_active == 0 and self.n_pending == 0
+                    and self.n_preempted == 0):
                 break
             self.step()
         else:

@@ -14,13 +14,22 @@ copy-on-write admission planner. Two invariants carry the whole design:
   writes into it. Everything past the prefix is freshly allocated. So a
   shared page is read-only by construction, and refcounts only ever
   gate RECLAMATION, never correctness.
-- **Reservation up front, zero mid-flight preemption.** Admission
-  reserves every page the request can EVER touch (prompt grid + decode
-  budget + speculative window) before the first chunk runs; a request
-  that can't reserve waits in the queue. Decode therefore never runs
-  out of pages mid-flight — the simple scheduler stays simple, and the
-  capacity story is still 4-8× (int4 rows + right-sized reservation vs
-  a dense max_seq slot; docs/TUNING.md has the accounting).
+- **Reservation up front, zero mid-flight preemption** (the default).
+  Admission reserves every page the request can EVER touch (prompt grid
+  + decode budget + speculative window) before the first chunk runs; a
+  request that can't reserve waits in the queue. Decode therefore never
+  runs out of pages mid-flight — the simple scheduler stays simple, and
+  the capacity story is still 4-8× (int4 rows + right-sized reservation
+  vs a dense max_seq slot; docs/TUNING.md has the accounting).
+  ``ContinuousBatcher(preemption=True)`` replaces the worst-case
+  reservation with an EVICTION tier: admission reserves only the prompt
+  grid, decode grows page-by-page, and under pressure the
+  lowest-priority slot's private pages swap to host (the handoff page
+  payload layout — :func:`gather_pages`) or drop for
+  recompute-from-prompt; refcounted CoW prefix pages are never evicted
+  while shared (releasing a reference never frees a page another owner
+  holds). Tokens are identical either way — preemption is pure
+  scheduling (docs/SERVING.md § Paged KV).
 
 Page 0 is the SCRATCH page: never allocated, named by every free/retired
 slot's table entries, so a dead slot's (masked, never-read) writes can't
@@ -43,6 +52,7 @@ __all__ = [
     "pages_for",
     "plan_admission",
     "copy_page",
+    "gather_pages",
     "prefill_prefix_into_pages",
     "export_pool_gauges",
     "note_page_wait",
@@ -179,6 +189,24 @@ def copy_page(pool, src, dst):
     so the two ends of a paged fleet cannot drift on copy semantics."""
     return [
         {key: a.at[dst].set(a[src]) for key, a in c.items()}
+        for c in pool
+    ]
+
+
+def gather_pages(pool, page_ids) -> list:
+    """Pull physical pages to host — per-layer dicts with a leading
+    shipped-page axis, the decode pool's own entry layout. THE one paged
+    row-payload format: prefill workers assemble handoffs from it
+    (``serving.handoff`` frames/CRCs it for the wire), and the
+    preemption tier's swap-out rides the SAME layout, so a swapped
+    request's host copy installs back through the identical
+    ``_install_pages`` scatter a handoff uses. A read: master/registry
+    pages stay intact."""
+    import jax.numpy as jnp
+
+    idx = jnp.asarray(list(page_ids), jnp.int32)
+    return [
+        {key: np.asarray(arr[idx]) for key, arr in c.items()}
         for c in pool
     ]
 

@@ -147,6 +147,10 @@ class PrefillWorker:
             # pages the prefix registry holds forever (the never-fits
             # checks subtract these from the reservable ceiling)
             self._registry_pages = 0
+            # pool gauges refresh at SCRAPE time (weakly-held collect
+            # hook): an idle worker's /metrics must show current
+            # occupancy, not freeze at the last tick's export
+            self._obs.add_collect_hook(self._export_pool_gauges)
         else:
             self.n_pages = 0
 
@@ -320,14 +324,13 @@ class PrefillWorker:
     # ---- scheduling ------------------------------------------------------
 
     def _gather_pages(self, page_ids) -> list:
-        """Pull physical pages to host as the handoff payload (per-layer
-        dicts with a leading shipped-page axis — the decode pool's own
-        entry layout). A read: master/registry pages stay intact."""
-        idx = jnp.asarray(list(page_ids), jnp.int32)
-        return [
-            {key: np.asarray(arr[idx]) for key, arr in c.items()}
-            for c in self._pool
-        ]
+        """Pull physical pages to host as the handoff payload — the
+        shared ``paging.gather_pages`` layout (per-layer dicts with a
+        leading shipped-page axis; the preemption tier's swap-out uses
+        the same format). A read: master/registry pages stay intact."""
+        from dsml_tpu.serving.paging import gather_pages
+
+        return gather_pages(self._pool, page_ids)
 
     def _paged_handoff(self, job: _Job, pages, n_full_prefix: int) -> Handoff:
         """Assemble a paged handoff from a job's pages: with
@@ -542,12 +545,16 @@ class PrefillWorker:
                 "prefilled requests handed to decode workers",
                 labels=("replica", "role"),
             ).inc(len(out), replica=self.obs_replica, role=self.obs_role)
-            if self.paged:
-                from dsml_tpu.serving.paging import export_pool_gauges
-
-                export_pool_gauges(self._obs, self._pages,
-                                   self.obs_replica, self.obs_role)
+            # pool gauges are scrape-time (collect hook), not per-tick
         return out
+
+    def _export_pool_gauges(self) -> None:
+        """Collect-hook body: current pool occupancy/free-list/CoW
+        gauges at every exposition (``Registry.add_collect_hook``)."""
+        from dsml_tpu.serving.paging import export_pool_gauges
+
+        export_pool_gauges(self._obs, self._pages,
+                           self.obs_replica, self.obs_role)
 
     def abandon(self) -> list[dict]:
         """Evacuate every unfinished job — queued and mid-chunk — as

@@ -567,7 +567,8 @@ class Router:
                 if not self._route_handoff(h):
                     self._ready.append(h)
         for dw in self.decode_workers:
-            if dw.n_active or dw.n_queued or dw.n_pending or dw.n_injected:
+            if (dw.n_active or dw.n_queued or dw.n_pending or dw.n_injected
+                    or dw.n_preempted):
                 dw.step()
                 self._harvest(dw)
         if self._obs.enabled:
@@ -726,11 +727,11 @@ def build_fleet(
     page pools everywhere, paged handoffs, decode-side CoW prefixes —
     docs/SERVING.md § Paged KV): ``page_size`` is fleet-wide,
     ``prefill_n_pages`` sizes the prefill pools, and decode pool sizes
-    ride ``decode_kwargs['n_pages']``. Paged is single-device per decode
-    worker (exclusive with ``devices``)."""
-    if paged_kv and devices is not None:
-        raise ValueError("paged_kv decode workers are single-device; "
-                         "drop devices= or paged_kv=")
+    ride ``decode_kwargs['n_pages']``. Paged composes with ``devices``:
+    a multi-chip decode worker shards its page pool's HEAD axis over tp
+    (``ContinuousBatcher.for_devices``), so every chip carries 1/tp of
+    each page — the capacity win lands per chip, tokens identical to a
+    single-device paged worker (pinned in tests)."""
     prefill_workers = [
         PrefillWorker(model, params, prefill_chunk,
                       max_queue=prefill_max_queue, paged_kv=paged_kv,

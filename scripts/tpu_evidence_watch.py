@@ -53,6 +53,10 @@ SECTIONS = [
     ("paged_kv", 900),  # paged int4 KV cache vs dense at equal HBM
     #                     (virtual-8 CPU subprocess; capacity-ratio +
     #                     bit-identity verdicts are the signal)
+    ("paged_attention", 900),  # Pallas paged kernel vs XLA gather: analytic
+    #                            live-vs-table HBM A/B + parity/tp2/eviction
+    #                            verdicts (virtual-8 CPU subprocess; on
+    #                            chips the kernel path runs compiled)
     ("long_context", 3000),  # cp=8 ring-attention ladder to 128k tokens
     #                          (virtual-8 CPU subprocess; completion, exact
     #                          KV wire bytes, headroom + parity verdicts)

@@ -591,6 +591,340 @@ def test_paged_inject_validation(setup):
         Router([dense_pw], [srv])
 
 
+# ---------------------------------------------------------------------------
+# eviction-based preemption (preemption=True)
+# ---------------------------------------------------------------------------
+
+
+def _pressure_prompts(cfg, seed=20):
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, cfg.vocab_size, l).astype(np.int32)
+               for l in (17, 9, 13)]
+    return prompts, [12, 12, 10]
+
+
+@pytest.mark.parametrize("policy", ["auto", "swap", "recompute"])
+def test_preemption_under_pressure_identical_tokens(setup, policy):
+    """The eviction tier is pure scheduling: a pool far too small for the
+    worst case admits against CURRENT demand, evicts under decode-growth
+    pressure, resumes the victim, and every request's tokens equal the
+    big-pool run — with zero pages leaked."""
+    cfg, model, params = setup
+    prompts, budgets = _pressure_prompts(cfg)
+    ref = ContinuousBatcher(model, params, n_slots=3, prefill_chunk=8,
+                            paged_kv="int4", page_size=8, n_pages=40)
+    want = _drain_tokens(ref, prompts, budgets)
+    srv = ContinuousBatcher(model, params, n_slots=3, prefill_chunk=8,
+                            paged_kv="int4", page_size=8, n_pages=8,
+                            preemption=True, preempt_policy=policy)
+    assert _drain_tokens(srv, prompts, budgets) == want
+    assert srv.n_preemptions > 0  # the pressure leg actually ran
+    if policy == "swap":
+        assert srv.n_swap_evictions == srv.n_preemptions
+    elif policy == "recompute":
+        assert srv.n_recompute_evictions == srv.n_preemptions
+    assert srv.free_pages == srv.n_pages - 1  # no leak
+    assert srv.n_preempted == 0  # every victim resumed and retired
+
+
+def test_preemption_speculative_identical_tokens(setup):
+    """Verify-window growth rides the same eviction tier: speculative
+    decode under page pressure emits the no-pressure run's tokens."""
+    cfg, model, params = setup
+    rng = np.random.default_rng(21)
+    prompts = [np.tile(rng.integers(1, 50, 6).astype(np.int32), 3)
+               for _ in range(3)]
+    ref = ContinuousBatcher(model, params, n_slots=2, prefill_chunk=8,
+                            speculative_window=4, paged_kv="int4",
+                            page_size=8, n_pages=40)
+    want = _drain_tokens(ref, prompts, [10] * 3)
+    srv = ContinuousBatcher(model, params, n_slots=2, prefill_chunk=8,
+                            speculative_window=4, paged_kv="int4",
+                            page_size=8, n_pages=9, preemption=True)
+    assert _drain_tokens(srv, prompts, [10] * 3) == want
+    assert srv.free_pages == srv.n_pages - 1
+
+
+def test_preemption_victim_order_priority_then_youngest(setup):
+    """The eviction order: lowest priority first, youngest rid within a
+    priority, the growing slot shielded via ``exclude`` until it is the
+    only one left."""
+    cfg, model, params = setup
+    srv = ContinuousBatcher(model, params, n_slots=3, prefill_chunk=8,
+                            paged_kv="int4", page_size=8, n_pages=20,
+                            preemption=True)
+    srv._slot_rid[:] = [5, 6, 7]
+    srv._slot_prio[:] = [1, 0, 0]
+    # priorities (1, 0, 0): slot 2 (prio 0, youngest rid 7) goes first
+    assert srv._pick_victim() == 2
+    assert srv._pick_victim(exclude=2) == 1
+    srv._slot_rid[:] = [5, -1, -1]
+    assert srv._pick_victim(exclude=0) is None  # nothing else holds pages
+    srv._slot_rid[:] = -1
+
+
+def test_preemption_priority_protects_high_priority_slot(setup):
+    """Under pressure the LOW-priority request is the one evicted; the
+    high-priority request decodes through without a single preemption —
+    and both finish with the reference tokens."""
+    cfg, model, params = setup
+    prompts, budgets = _pressure_prompts(cfg)
+    ref = ContinuousBatcher(model, params, n_slots=3, prefill_chunk=8,
+                            paged_kv="int4", page_size=8, n_pages=40)
+    want = _drain_tokens(ref, prompts, budgets)
+
+    srv = ContinuousBatcher(model, params, n_slots=3, prefill_chunk=8,
+                            paged_kv="int4", page_size=8, n_pages=8,
+                            preemption=True)
+    evicted = []
+    orig = srv._evict_slot
+
+    def spy(slot):
+        evicted.append(int(srv._slot_rid[slot]))
+        orig(slot)
+
+    srv._evict_slot = spy
+    rids = [srv.submit(p, n, priority=(10 if i == 0 else 0))
+            for i, (p, n) in enumerate(zip(prompts, budgets))]
+    out = srv.run()
+    assert [out[r] for r in rids] == want
+    assert evicted and rids[0] not in evicted  # priority 10 never evicted
+
+
+def test_preemption_never_evicts_shared_cow_pages(setup):
+    """A CoW-shared prefix page is NEVER swapped or freed while shared:
+    eviction only drops the victim's reference — the registry master
+    survives every preemption storm byte-intact, and matching requests
+    keep sharing it."""
+    cfg, model, params = setup
+    rng = np.random.default_rng(22)
+    prefix = rng.integers(1, cfg.vocab_size, 16).astype(np.int32)  # 2 pages
+    tails = [rng.integers(1, cfg.vocab_size, 5).astype(np.int32)
+             for _ in range(3)]
+    prompts = [np.concatenate([prefix, t]) for t in tails]
+
+    plain = ContinuousBatcher(model, params, n_slots=3, prefill_chunk=8,
+                              paged_kv="int4", page_size=8, n_pages=40)
+    plain.register_prefix(prefix)
+    want = _drain_tokens(plain, prompts, [10] * 3)
+
+    srv = ContinuousBatcher(model, params, n_slots=3, prefill_chunk=8,
+                            paged_kv="int4", page_size=8, n_pages=8,
+                            preemption=True)
+    srv.register_prefix(prefix)
+    reg_pages = list(srv._prefixes[0][1])
+    master = [{key: np.asarray(arr[np.asarray(reg_pages)])
+               for key, arr in layer.items()} for layer in srv._pool]
+    rids = [srv.submit(p, 10) for p in prompts]
+    min_ref = 10 ** 9
+    out = {}
+    while srv.n_active or srv.n_queued or srv.n_pending or srv.n_preempted:
+        out.update(srv.step())
+        # the registry's own reference never drops, evictions included
+        min_ref = min(min_ref, *(srv._pages.refcount(p)
+                                 for p in reg_pages[:2]))
+    out.update(srv.collect())
+    assert [out[r] for r in rids] == want
+    assert srv.n_preemptions > 0
+    assert min_ref >= 1  # master reference held throughout
+    for layer, m in zip(srv._pool, master):
+        for key in m:  # registry bytes untouched by the storm
+            assert np.array_equal(np.asarray(layer[key][np.asarray(reg_pages)]), m[key])
+    assert srv.used_pages == len(reg_pages)  # only the registry stays
+
+
+def test_preemption_constructor_and_submit_validation(setup):
+    cfg, model, params = setup
+    with pytest.raises(ValueError, match="paged_kv"):
+        ContinuousBatcher(model, params, preemption=True)
+    with pytest.raises(ValueError, match="preempt_policy"):
+        ContinuousBatcher(model, params, paged_kv="int4", page_size=8,
+                          prefill_chunk=8, preemption=True,
+                          preempt_policy="drop")
+    # the never-fits check stays WORST-CASE under preemption: eviction
+    # cannot shrink one request's own eventual footprint
+    srv = ContinuousBatcher(model, params, n_slots=2, prefill_chunk=8,
+                            paged_kv="int4", page_size=8, n_pages=10,
+                            preemption=True)
+    with pytest.raises(ValueError, match="ever reservable"):
+        srv.submit(np.arange(1, 100, dtype=np.int32), 20)
+
+
+def test_preemption_fleet_injected_slot_keeps_cow_boundary(setup):
+    """The inject path (paged handoff admission) must record the CoW
+    boundary too: an injected slot's shared prefix pages are
+    reference-only, so a later eviction drops the reference instead of
+    swapping registry pages out as if they were private — fleet +
+    preemption drains with reference tokens, preemptions exercised, and
+    every decode pool back to exactly its registry pages."""
+    cfg, model, params = setup
+    rng = np.random.default_rng(30)
+    prefix = rng.integers(1, cfg.vocab_size, 16).astype(np.int32)  # 2 pages
+    prompts = [np.concatenate(
+        [prefix, rng.integers(1, cfg.vocab_size, 5).astype(np.int32)])
+        for _ in range(3)]
+    mono = ContinuousBatcher(model, params, n_slots=3, prefill_chunk=8,
+                             paged_kv="int4", page_size=8, n_pages=60)
+    mono.register_prefix(prefix)
+    want = _drain_tokens(mono, prompts, [10] * 3)
+
+    router = build_fleet(model, params, n_prefill=1, n_decode=1,
+                         prefill_chunk=8, paged_kv="int4", page_size=8,
+                         n_slots=3, n_pages=8, preemption=True)
+    router.register_prefix(prefix)
+    dw = router.decode_workers[0]
+    frids = [router.submit(p, 10) for p in prompts]
+    saw_shared_inject = 0
+    ticks = 0
+    while router.outstanding:
+        router.tick()
+        # white-box: every occupied slot admitted via inject carries its
+        # shared-page count (the eviction tier's CoW boundary)
+        for s in np.flatnonzero(dw._slot_rid >= 0):
+            saw_shared_inject = max(saw_shared_inject,
+                                    int(dw._slot_shared[int(s)]))
+        ticks += 1
+        assert ticks < 100_000, "fleet did not drain under preemption"
+    out = router.run(max_ticks=1)
+    assert [out[f] for f in frids] == want
+    assert dw.n_preemptions > 0  # an injected slot really was evicted
+    assert saw_shared_inject == 2  # the boundary rode the inject path
+    assert dw.used_pages == pages_for(len(prefix), 8)  # registry only
+
+
+# ---------------------------------------------------------------------------
+# TP-sharded page pool (mesh= composes with paged_kv)
+# ---------------------------------------------------------------------------
+
+
+def test_tp2_paged_batcher_matches_single_device(setup, devices8):
+    """mesh= shards the page pool's HEAD axis over tp: tokens identical
+    to the single-device paged batcher (and so to dense), each chip
+    holding 1/tp of every page — the capacity win lands per chip."""
+    from dsml_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    cfg, model, params = setup
+    prompts = _prompts(cfg, [5, 17, 32, 9], seed=23)
+    budgets = [5, 3, 6, 5]
+    ref = ContinuousBatcher(model, params, n_slots=2, prefill_chunk=8,
+                            paged_kv="int4", page_size=8, n_pages=40)
+    want = _drain_tokens(ref, prompts, budgets)
+
+    mesh = build_mesh(MeshSpec(tp=2), devices8[:2])
+    srv = ContinuousBatcher(model, params, n_slots=2, prefill_chunk=8,
+                            paged_kv="int4", page_size=8, n_pages=40,
+                            mesh=mesh)
+    assert _drain_tokens(srv, prompts, budgets) == want
+    assert srv.free_pages == srv.n_pages - 1
+    # the pool is genuinely head-sharded: each chip holds H/tp heads of
+    # every page — per-chip pool bytes are 1/tp of the global pool
+    shard = srv._pool[0]["k"].addressable_shards[0]
+    assert shard.data.shape[1] == cfg.n_head // 2
+    assert shard.data.shape[0] == srv.n_pages  # page axis replicated
+
+    with pytest.raises(ValueError, match="divisible by tp"):
+        ContinuousBatcher(model, params, n_slots=2, prefill_chunk=8,
+                          paged_kv="int4", page_size=8, n_pages=40,
+                          mesh=build_mesh(MeshSpec(tp=3), devices8[:3]))
+
+
+def test_tp2_paged_fleet_matches_monolithic(setup, devices8):
+    """The acceptance leg: a paged fleet whose decode workers each carry
+    tp=2 (``build_fleet(devices=...)``) drains with tokens identical to
+    the monolithic single-device paged batcher, prefix elision live."""
+    cfg, model, params = setup
+    rng = np.random.default_rng(24)
+    prefix = rng.integers(1, cfg.vocab_size, 16).astype(np.int32)
+    prompts = []
+    for i in range(4):
+        tail = rng.integers(1, cfg.vocab_size,
+                            int(rng.integers(3, 10))).astype(np.int32)
+        prompts.append(np.concatenate([prefix, tail]) if i % 2 else
+                       rng.integers(1, cfg.vocab_size,
+                                    int(rng.integers(5, 20))).astype(np.int32))
+    mono = ContinuousBatcher(model, params, n_slots=2, prefill_chunk=8,
+                             paged_kv="int4", page_size=8, n_pages=60)
+    mono.register_prefix(prefix)
+    want = _drain_tokens(mono, prompts, [5] * 4)
+
+    router = build_fleet(model, params, n_prefill=1, n_decode=2,
+                         prefill_chunk=8, paged_kv="int4", page_size=8,
+                         n_slots=2, n_pages=60, devices=devices8[:4])
+    router.register_prefix(prefix)
+    frids = [router.submit(p, 5) for p in prompts]
+    out = router.run()
+    assert [out[f] for f in frids] == want
+    for dw in router.decode_workers:
+        assert dw.mesh is not None and dw.mesh.shape["tp"] == 2
+        assert dw.used_pages == pages_for(len(prefix), 8)
+
+
+def test_tp2_paged_capacity_ratio_per_chip(setup):
+    """The ≥4× capacity story survives TP: at the dense f32 cache's
+    per-chip HBM budget, the int4 page pool's per-chip rows (heads/tp of
+    every page) hold ≥4× the sequences — the analytic accounting the
+    bench's tp=2 leg measures."""
+    cfg, model, params = setup
+    hd = cfg.d_model // cfg.n_head
+    tp = 2
+    page_size = 8
+    # per-chip bytes of ONE dense f32 slot vs ONE int4 page (both carry
+    # n_head/tp heads per chip)
+    dense_slot = cfg.n_layer * 2 * (cfg.n_head // tp) * cfg.max_seq \
+        * kv_row_bytes(hd, None)
+    page = cfg.n_layer * 2 * (cfg.n_head // tp) * page_size \
+        * kv_row_bytes(hd, "int4")
+    n_dense_slots = 4
+    budget = n_dense_slots * dense_slot
+    rows_at_budget = (budget // page) * page_size
+    assert rows_at_budget / (n_dense_slots * cfg.max_seq) >= 4.0
+
+
+# ---------------------------------------------------------------------------
+# metrics: scrape-time collect hook
+# ---------------------------------------------------------------------------
+
+
+def test_page_pool_gauges_fresh_at_scrape_without_ticks(setup):
+    """The fix: pool gauges export at SCRAPE time (collect hook), not per
+    tick — occupancy changes between ticks (here: a prefix registration
+    with zero ``step()`` calls) show up at the next collect instead of
+    freezing at the last tick's values."""
+    from dsml_tpu import obs
+    from dsml_tpu.serving import PrefillWorker
+
+    cfg, model, params = setup
+    obs.enable(forensics=False)
+    try:
+        srv = ContinuousBatcher(model, params, n_slots=2, prefill_chunk=8,
+                                paged_kv="int4", page_size=8, n_pages=40)
+        pw = PrefillWorker(model, params, 8, paged_kv="int4", page_size=8,
+                           n_pages=20)
+
+        def scrape(role):
+            return {r["name"]: r["value"]
+                    for r in obs.get_registry().collect()
+                    if r["name"].startswith("serving_page_pool")
+                    and r["labels"].get("role") == role}
+
+        # no tick has EVER run: the hook still exports current occupancy
+        assert scrape("decode")["serving_page_pool_free"] == srv.free_pages
+        assert scrape("prefill")["serving_page_pool_free"] == \
+            pw._pages.free_pages
+        before = scrape("decode")["serving_page_pool_used"]
+        rng = np.random.default_rng(25)
+        srv.register_prefix(rng.integers(1, cfg.vocab_size, 24).astype(np.int32))
+        pw.register_prefix(rng.integers(1, cfg.vocab_size, 16).astype(np.int32))
+        after = scrape("decode")
+        # occupancy moved with ZERO ticks in between — per-tick export
+        # would still show `before`
+        assert after["serving_page_pool_used"] == before + 3 == srv.used_pages
+        assert scrape("prefill")["serving_page_pool_used"] == \
+            pw._pages.used_pages
+    finally:
+        obs.disable()
+
+
 def test_page_pool_metrics_exported(setup):
     """Satellite: pool occupancy/free-list/acceptance gauges land in the
     metrics registry with (replica, role) labels."""

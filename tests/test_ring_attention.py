@@ -16,9 +16,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dsml_tpu.ops.attention import attention
 from dsml_tpu.ops.ring_attention import (
+    causal_critical_path_fraction,
     causal_keep_fraction,
     ring_attention,
     ring_kv_wire_bytes,
+    zigzag_indices,
+    zigzag_inverse,
 )
 
 
@@ -153,6 +156,112 @@ def test_causal_keep_fraction():
     assert causal_keep_fraction(2) == 0.75
     assert causal_keep_fraction(8) == pytest.approx(9 / 16)
     # asymptotically the causal-mask 2×
+    assert causal_keep_fraction(1024) == pytest.approx(0.5, abs=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# zigzag/striped shard ordering (the causal load-balance fix)
+# ---------------------------------------------------------------------------
+
+
+def _zigzag_fn(mesh, causal):
+    spec = P(None, None, "cp", None)
+    return jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, "cp", causal,
+                                           layout="zigzag"),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+        )
+    )
+
+
+def test_zigzag_permutation_places_paired_stripes():
+    """Rank r gets stripes {r, 2n−1−r}: an early stripe paired with a
+    late one, and the inverse restores global order exactly."""
+    perm = zigzag_indices(2, 8)  # stripe=2: r0 → {0,3}, r1 → {1,2}
+    np.testing.assert_array_equal(perm, [0, 1, 6, 7, 2, 3, 4, 5])
+    inv = zigzag_inverse(2, 8)
+    np.testing.assert_array_equal(perm[inv], np.arange(8))
+    np.testing.assert_array_equal(inv[perm], np.arange(8))
+    with pytest.raises(ValueError, match="2·cp stripes"):
+        zigzag_indices(2, 10)
+
+
+# parity at cp ∈ {2, 4}, causal AND non-causal, including a per-rank
+# length (2·13=26 rows at cp=2... 52/2) whose stripes are odd flash blocks
+@pytest.mark.parametrize("cp,s", [(2, 64), (2, 52), (4, 96), (4, 104)])
+@pytest.mark.parametrize("causal", [True, False])
+def test_zigzag_forward_matches_full_attention(devices8, cp, s, causal):
+    """The satellite pin: zigzag-sharded ring attention ≡ dense attention
+    after un-permuting — causal skipping now predicates per stripe pair,
+    and the answer must not move."""
+    q, k, v = _qkv(s, seed=cp * 10 + s)
+    perm, inv = zigzag_indices(cp, s), zigzag_inverse(cp, s)
+    fn = _zigzag_fn(_cp_mesh(devices8, cp), causal)
+    got = np.asarray(
+        fn(q[:, :, perm], k[:, :, perm], v[:, :, perm])[:, :, inv]
+    )
+    expected = np.asarray(attention(q, k, v, causal))
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("cp,s", [(2, 64), (4, 96)])
+def test_zigzag_backward_matches_full_attention(devices8, cp, s):
+    """Gradients through the stripe-blocked backward (dq per stripe,
+    dk/dv touring the ring) equal the dense reference's for all three
+    operands."""
+    q, k, v = _qkv(s, seed=17)
+    perm, inv = zigzag_indices(cp, s), zigzag_inverse(cp, s)
+    fn = _zigzag_fn(_cp_mesh(devices8, cp), True)
+    w = jnp.cos(jnp.arange(q.shape[-1]))
+
+    def loss(q, k, v):
+        out = fn(q[:, :, perm], k[:, :, perm], v[:, :, perm])[:, :, inv]
+        return jnp.sum(out * w)
+
+    grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    ref = jax.grad(
+        lambda q, k, v: jnp.sum(attention(q, k, v, True) * w),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for g, r in zip(grads, ref):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-3, atol=2e-4)
+
+
+def test_zigzag_validation(devices8):
+    with pytest.raises(ValueError, match="layout"):
+        ring_attention(jnp.zeros((1, 2, 8, 16)), jnp.zeros((1, 2, 8, 16)),
+                       jnp.zeros((1, 2, 8, 16)), "cp", layout="striped")
+    spec = P(None, None, "cp", None)
+    fn = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "cp", True, layout="zigzag"),
+        mesh=_cp_mesh(devices8, 2), in_specs=(spec,) * 3, out_specs=spec,
+        check_vma=False,
+    ))
+    with pytest.raises(ValueError, match="even per-rank"):
+        fn(*_qkv(10))  # 5 rows per rank: stripes can't split evenly
+
+
+def test_zigzag_keep_fraction_and_critical_path():
+    """The load-balance arithmetic: zigzag keeps the SAME asymptotic mean
+    ((2n+1)/4n → ½) but makes it constant per rank, so the critical path
+    drops from 1.0 (contiguous rank n−1 runs everything) to the mean —
+    the ~2× wall win at large cp."""
+    for n, frac in ((2, 5 / 8), (4, 9 / 16)):
+        assert causal_keep_fraction(n, "zigzag") == pytest.approx(frac)
+        # constant per-rank work ⇒ critical path IS the mean
+        assert causal_critical_path_fraction(n, "zigzag") == \
+            causal_keep_fraction(n, "zigzag")
+        # contiguous: same-ish mean, but the LAST rank runs its whole grid
+        assert causal_critical_path_fraction(n, "contiguous") == 1.0
+        assert causal_critical_path_fraction(n, "zigzag") < 1.0
+    assert causal_keep_fraction(1, "zigzag") == 1.0
+    assert causal_critical_path_fraction(1) == 1.0
+    # asymptotics: both layouts' means → the causal-mask 2×
+    assert causal_keep_fraction(1024, "zigzag") == pytest.approx(0.5, abs=1e-3)
+    # non-causal executes everything either way (layout is causal-only
+    # load balancing; parity pinned above)
     assert causal_keep_fraction(1024) == pytest.approx(0.5, abs=1e-3)
 
 
