@@ -59,6 +59,11 @@ class AsyncWriter:
         self._name = name
         self.deadline_s = (deadline_s if deadline_s is not None
                            else _commit_deadline_s())
+        # host bytes of snapshots queued-or-committing (the memory the
+        # async path STAGES between the device→host copy and the rename);
+        # a ledger source + gauge so leaked staging shows up, not just
+        # queue depth
+        self._staged_nbytes = 0.0
         self._jobs: collections.deque = collections.deque()
         self._lock = threading.Lock()
         self._idle = threading.Condition(self._lock)
@@ -73,6 +78,19 @@ class AsyncWriter:
         self._recorder = flight_recorder.get_flight_recorder()
         hw_cfg = hangwatch.config_from_env()
         self._hangwatch = hangwatch.get_hangwatch() if hw_cfg is not None else None
+        # memory-ledger source (docs/OBSERVABILITY.md § Memory ledger):
+        # weakly held, dies with this writer
+        from dsml_tpu.obs.memory import get_memory_ledger
+
+        get_memory_ledger(self._obs).register_source(
+            "checkpoint_staging", self.staged_bytes,
+            name=f"{self._name}/{id(self):x}",
+        )
+
+    def staged_bytes(self) -> float:
+        """Host bytes of snapshots not yet committed (queued + in-flight)."""
+        with self._lock:
+            return self._staged_nbytes
 
     def _note_depth(self) -> None:
         # caller holds self._lock
@@ -80,11 +98,19 @@ class AsyncWriter:
             "checkpoint_queue_depth", "async checkpoint jobs pending",
             labels=("writer",),
         ).set(len(self._jobs) + (1 if self._busy else 0), writer=self._name)
+        self._obs.gauge(
+            "checkpoint_staging_bytes",
+            "host snapshot bytes awaiting background commit",
+            labels=("writer",),
+        ).set(self._staged_nbytes, writer=self._name)
 
-    def submit(self, fn, label: str | None = None) -> None:
+    def submit(self, fn, label: str | None = None,
+               nbytes: float = 0.0) -> None:
         """Queue ``fn()`` for background execution; raises any held error
         from a previous job first. ``label`` (e.g. ``"step 42"``) names the
-        job in deadline warnings and flight-recorder events."""
+        job in deadline warnings and flight-recorder events; ``nbytes`` is
+        the staged payload the job holds until it completes (the ledger's
+        ``checkpoint_staging`` accounting — released success or fail)."""
         self.check_error()
         with self._lock:
             if self._closed:
@@ -102,7 +128,8 @@ class AsyncWriter:
                     time.monotonic() - self._busy_since, self.deadline_s,
                     len(self._jobs),
                 )
-            self._jobs.append((fn, label))
+            self._jobs.append((fn, label, float(max(nbytes, 0.0))))
+            self._staged_nbytes += float(max(nbytes, 0.0))
             self._note_depth()
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
@@ -118,7 +145,7 @@ class AsyncWriter:
                     self._idle.wait(timeout=1.0)
                 if not self._jobs:
                     return  # closed and drained
-                fn, label = self._jobs.popleft()
+                fn, label, job_nbytes = self._jobs.popleft()
                 self._busy = True
                 self._busy_since = time.monotonic()
                 self._overdue_warned = False
@@ -163,6 +190,10 @@ class AsyncWriter:
                     self._busy = False
                     self._busy_since = None
                     self._current_label = None
+                    # the snapshot is durable (or dead) either way — its
+                    # host bytes are no longer staged
+                    self._staged_nbytes = max(
+                        self._staged_nbytes - job_nbytes, 0.0)
                     self._note_depth()
                     self._idle.notify_all()
                 if wall_ms > self.deadline_s * 1e3:

@@ -74,8 +74,13 @@ class CheckpointManager:
             self._gc()
 
         # the label surfaces in commit-deadline warnings and flight events,
-        # so a stuck wait() names the step it is blocked on
-        self._writer.submit(job, label=f"step {step}")
+        # so a stuck wait() names the step it is blocked on; the blob
+        # bytes ride along as the ledger's checkpoint_staging claim
+        # (held until the commit lands, success or fail)
+        self._writer.submit(
+            job, label=f"step {step}",
+            nbytes=sum(int(b.nbytes) for _, b in snap.blobs),
+        )
         get_registry().counter(
             "checkpoint_saves_total", "checkpoint save submissions",
             labels=("mode",),

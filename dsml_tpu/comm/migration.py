@@ -167,6 +167,21 @@ class StateDonor:
         # receiver expecting a specific step REFUSES a stale donor instead
         # of silently landing old bytes that pass their own CRCs
         self.version = None
+        # memory-ledger source (docs/OBSERVABILITY.md § Memory ledger):
+        # the staging spans whose background pushes are still reading
+        # them — a wedged stream shows up as bytes that never release
+        from dsml_tpu.obs.memory import get_memory_ledger
+
+        get_memory_ledger().register_source(
+            "migration_staging", self.staged_bytes, name=f"donor/{id(self):x}"
+        )
+
+    def staged_bytes(self) -> float:
+        """Bytes of staging spans still owned by in-flight (or reserved)
+        sends — terminal streams are pruned before counting."""
+        with self._lock:
+            self._prune_stages_locked()
+            return float(sum(span for _, span in self._live_stages.values()))
 
     # -- registration ------------------------------------------------------
 
@@ -240,6 +255,12 @@ class StateDonor:
             out[key] = info
         return out
 
+    def _note_staged_locked(self) -> None:
+        get_registry().gauge(
+            "migration_staging_bytes",
+            "donor staging-area bytes held by in-flight P2P sends",
+        ).set(float(sum(s for _, s in self._live_stages.values())))
+
     def _prune_stages_locked(self) -> None:
         for sid in list(self._live_stages):
             if not isinstance(sid, int):
@@ -247,6 +268,7 @@ class StateDonor:
             st = self.runtime.streams.get(sid)
             if st is None or st.status != pb.IN_PROGRESS:
                 del self._live_stages[sid]
+        self._note_staged_locked()
 
     def _stage(self, nbytes: int) -> tuple[int, object]:
         """Sequential staging allocator over the registry's upper half,
@@ -281,6 +303,7 @@ class StateDonor:
                     )
             self._stage_next = addr + span
             self._live_stages[token] = (addr, span)
+            self._note_staged_locked()
             return addr, token
 
     def _commit_stage(self, token: object, stream_id: int) -> None:
@@ -290,6 +313,7 @@ class StateDonor:
     def _abort_stage(self, token: object) -> None:
         with self._lock:
             self._live_stages.pop(token, None)
+            self._note_staged_locked()
 
     def begin_pieces(self, pieces: list[dict], dst_rank: int) -> list[dict]:
         """Serialize + BeginSend each requested piece; returns one stream

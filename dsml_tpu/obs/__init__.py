@@ -46,6 +46,18 @@ Cluster plane (``docs/OBSERVABILITY.md`` § Cluster):
   dsml_tpu.obs.regress`` exits nonzero on regression and exports the
   calibrated collective-latency profile for the cost-model planner.
 
+Memory ledger (``docs/OBSERVABILITY.md`` § Memory ledger):
+
+- :mod:`~dsml_tpu.obs.memory` — per-subsystem device-byte attribution
+  (:class:`MemoryLedger`): static claims at allocation sites (params /
+  optimizer / EF residuals / measured activation temps) + weakly-held
+  live sources (KV page pools, migration/checkpoint staging), reconciled
+  against ``jax.Device.memory_stats()`` at scrape time with an
+  ``hbm_unattributed_bytes`` residual gauge and explicit provenance
+  (``hbm_source``). Per-step peak watermarks ride postmortem bundles
+  (``memory.json``); OOM-shaped crashes dump through
+  :func:`~dsml_tpu.obs.memory.maybe_dump_oom`.
+
 Request tracing + SLO budgets (``docs/OBSERVABILITY.md`` § Request
 tracing & SLO budgets):
 
@@ -79,6 +91,10 @@ from dsml_tpu.obs.flight_recorder import (  # noqa: F401
     get_flight_recorder,
 )
 from dsml_tpu.obs.hangwatch import HangWatch, TrailingDeadline, get_hangwatch  # noqa: F401
+from dsml_tpu.obs.memory import (  # noqa: F401
+    MemoryLedger,
+    get_memory_ledger,
+)
 from dsml_tpu.obs.registry import (  # noqa: F401
     DEFAULT_LATENCY_BUCKETS_MS,
     Counter,
@@ -119,6 +135,7 @@ __all__ = [
     "record_collective_plan", "observe_collective_latency_ms",
     "observe_recovery_ms", "record_quant_sync_bytes",
     "FlightRecorder", "get_flight_recorder", "dump_postmortem",
+    "MemoryLedger", "get_memory_ledger",
     "SentinelConfig", "SentinelTripped", "TrainingSentinels",
     "HangWatch", "TrailingDeadline", "get_hangwatch",
     "ClockSync", "ClusterAggregator", "merge_snapshots", "snapshot",

@@ -486,6 +486,31 @@ class MergedView:
                    "n": len(v)}
             for name, v in sorted(gauges.items())
         }
+        # the memory ledger's fleet view (docs/OBSERVABILITY.md § Memory
+        # ledger): per-host headroom/unattributed/claimed merged min/mean/
+        # max — gauges are NEVER summed (two hosts' headroom doesn't add),
+        # so the min row is the fleet's binding chip and the max row its
+        # roomiest. Keyed without the hbm_ prefix but WITH the series'
+        # non-identity labels: pooling hbm_measured_bytes by bare name
+        # would take a min over bytes_in_use readings and a max over
+        # bytes_limit — cross-kind garbage. Empty when no process
+        # exported ledger gauges.
+        mem_vals: dict[str, list[float]] = {}
+        for rec in self._proc_series:
+            if rec["type"] != "gauge" or not rec["name"].startswith("hbm_"):
+                continue
+            extra = {k: v for k, v in rec["labels"].items()
+                     if k not in IDENTITY_LABELS}
+            key = rec["name"][len("hbm_"):]
+            if extra:
+                key += "{" + ",".join(
+                    f"{k}={v}" for k, v in sorted(extra.items())) + "}"
+            mem_vals.setdefault(key, []).append(float(rec["value"]))
+        memory_rows = {
+            key: {"min": min(v), "mean": sum(v) / len(v), "max": max(v),
+                  "n": len(v)}
+            for key, v in sorted(mem_vals.items())
+        }
         return {
             "schema": "dsml.obs.cluster_report/1",
             "processes": self.processes,
@@ -493,6 +518,7 @@ class MergedView:
             "fleet_goodput": self.fleet_goodput(),
             "stragglers": self.straggler_ranking(),
             "gauges": gauge_rows,
+            "memory": memory_rows,
             "slo": self.slo_status(),
             "notes": self.notes,
         }

@@ -215,6 +215,17 @@ class FlightRecorder:
         write("fingerprint.json", lambda f: json.dump(_fingerprint(), f, indent=1))
         write("stacks.txt", lambda f: f.write(_all_thread_stacks()))
 
+        def write_memory(f):
+            # the ledger snapshot + watermark timeline + live source
+            # readings (page-pool state rides as the kv_pages details) —
+            # resolved through THIS recorder's registry, so a private
+            # bench recorder never leaks the process ledger's claims
+            from dsml_tpu.obs.memory import get_memory_ledger
+
+            json.dump(get_memory_ledger(self.registry).snapshot(), f, indent=1)
+
+        write("memory.json", write_memory)
+
         def write_log_tail(f):
             from dsml_tpu.utils.logging import get_ring_handler
 
@@ -317,7 +328,16 @@ def install(recorder: FlightRecorder | None = None) -> None:
                 # already wrote its postmortem at trip time — a second
                 # near-identical unhandled_exception bundle is pure churn
                 if getattr(e, "bundle", None) is None:
-                    rec.dump("unhandled_exception", exc=e)
+                    from dsml_tpu.obs.memory import is_oom
+
+                    # an OOM-shaped death is named as one, so the bundle
+                    # directory itself says "memory" before anyone opens
+                    # memory.json
+                    rec.dump(
+                        "resource_exhausted" if is_oom(e)
+                        else "unhandled_exception",
+                        exc=e,
+                    )
             except Exception:  # noqa: BLE001 — never mask the real crash
                 pass
             _prev_excepthook(etype, value, tb)

@@ -136,6 +136,15 @@ class Gauge(_Metric):
 
     kind = "gauge"
 
+    def clear(self) -> None:
+        """Drop every labeled series. For scrape-time re-derived gauges
+        whose LABEL SETS change between scrapes (the memory ledger's
+        per-subsystem claims, its provenance flag): without a clear, a
+        series whose source died — or whose provenance flipped — would
+        freeze at its last value in every later exposition."""
+        with self._lock:
+            self._series.clear()
+
     def set(self, value: float, **labels) -> None:
         if not self._registry._enabled:
             return

@@ -199,6 +199,11 @@ _NOT_A_METRIC = (
     # rule below, tp2_capacity_ratio up-good via "capacity_ratio", and
     # the preemption-vs-reservation throughput rows up-good via
     # "tokens_per_sec".
+    # memory section: availability/provenance flags, device/watermark
+    # counts, and the injected self-check's expectation constants are
+    # structure, not perf (the residual/overhead rows gate through the
+    # explicit memory rules in metric_direction below)
+    "stats_available", "_watermarks", "memory_oom_", "expected_",
     # long_context section: ladder geometry + analytic accounting rows.
     # The KV wire-byte rows are EXACT schedule counts (the generic "_bytes"
     # rule above already exempts them — a changed count is a schedule
@@ -238,10 +243,29 @@ _LOWER_BETTER_CONTAINS = ("loss", "overhead", "stall", "latency", "ttft",
                           "tick_p50")
 
 
+# memory-ledger rows (ISSUE 15): peak-byte watermarks and the
+# unattributed residual gate DOWN-GOOD even though the generic "_bytes"
+# rule above exempts byte rows (those are analytic schedule counts; a
+# PEAK is a measurement — more resident bytes at the same workload is a
+# memory regression exactly like a slower step is a latency regression).
+# Capacity/provenance rows stay ungated: bytes_limit is the chip, not
+# the code, and the claimed-taxonomy rows are attribution bookkeeping
+# whose "regressions" are the contract test's business.
+_MEMORY_NEVER_GATED = ("bytes_limit", "claimed_", "hbm_source")
+# "unattributed_bytes"/"_gb", not bare "unattributed": the fleet-merge
+# structure row memory_fleet_unattributed_rows is a process COUNT
+_MEMORY_DOWN_GOOD = ("peak_bytes", "peak_gb", "unattributed_bytes",
+                     "unattributed_gb")
+
+
 def metric_direction(name: str) -> str | None:
     """"higher" / "lower" = which way is GOOD; None = not a perf metric
     (config constants, provenance counts) — never gated."""
     low = name.lower()
+    if any(t in low for t in _MEMORY_NEVER_GATED):
+        return None
+    if any(t in low for t in _MEMORY_DOWN_GOOD):
+        return "lower"
     if any(t in low for t in _NOT_A_METRIC):
         return None
     if any(t in low for t in _HIGHER_BETTER):

@@ -40,8 +40,16 @@ from __future__ import annotations
 import dataclasses
 
 from dsml_tpu.parallel.mesh import MeshSpec
+from dsml_tpu.utils.logging import get_logger
 
 __all__ = ["plan_mesh", "AutoPlan", "measured_activation_bytes"]
+
+log = get_logger("auto")
+
+# the pre-ledger fiction, now never silent: every plan that uses it warns
+# once and stamps its provenance into the plan AND the obs registry
+FALLBACK_HBM_BYTES = 16e9
+_warned_fallback = False
 
 
 def measured_activation_bytes(loss_fn, *example_args) -> float | None:
@@ -79,6 +87,11 @@ class AutoPlan:
     # the pipeline bubble by the same factor; 1 when no pipeline (or no
     # divisible chunking exists)
     pp_interleave: int = 1
+    # where the per-chip HBM number came from: "caller" (explicit
+    # hbm_bytes=), "memory_stats" (measured), or "fallback" (the 16 GB
+    # constant — trust the plan accordingly). The plan REPORT carries the
+    # provenance, not just the audit-trail prose.
+    hbm_source: str = "caller"
 
 
 def _divisors_desc(n: int, limit: int) -> list[int]:
@@ -89,7 +102,12 @@ def _device_hbm_bytes(device=None) -> tuple[float, str]:
     """Per-chip HBM from the hardware (``memory_stats()['bytes_limit']``),
     with an explicit fallback constant when the backend doesn't report one
     (CPU meshes, older runtimes). Returns (bytes, provenance) so the plan's
-    audit trail records where the number came from."""
+    audit trail records where the number came from. The fallback is never
+    silent (VERDICT weak point): first use logs a warning, and every plan
+    exports ``plan_hbm_bytes{source}`` so a dashboard (or the plan_mesh
+    report) shows whether capacity math ran on a measurement or a guess."""
+    global _warned_fallback
+    nbytes, source = FALLBACK_HBM_BYTES, "fallback"
     try:
         if device is None:
             import jax
@@ -99,10 +117,30 @@ def _device_hbm_bytes(device=None) -> tuple[float, str]:
         limit = (stats or {}).get("bytes_limit")
         if limit:
             kind = getattr(device, "device_kind", "?")
-            return float(limit), f"memory_stats of {kind}"
+            nbytes, source = float(limit), "memory_stats"
+            detail = f"memory_stats of {kind}"
     except Exception:
         pass
-    return 16e9, "fallback constant (device reports no memory_stats)"
+    if source == "fallback":
+        detail = (f"fallback constant {FALLBACK_HBM_BYTES/1e9:.0f} GB "
+                  "(device reports no memory_stats)")
+        if not _warned_fallback:
+            _warned_fallback = True
+            log.warning(
+                "plan_mesh: device reports no memory_stats — capacity "
+                "planning assumes %.0f GB/chip; pass hbm_bytes= (or run on "
+                "a stats-reporting backend) for a measured plan",
+                FALLBACK_HBM_BYTES / 1e9,
+            )
+    from dsml_tpu.obs import get_registry
+
+    get_registry().gauge(
+        "plan_hbm_bytes",
+        "per-chip HBM the mesh planner used, by provenance "
+        "(memory_stats = measured, fallback = the 16 GB constant)",
+        labels=("source",),
+    ).set(nbytes, source=source)
+    return nbytes, detail
 
 
 def plan_mesh(
@@ -136,9 +174,25 @@ def plan_mesh(
     if n_devices < 1:
         raise ValueError(f"n_devices must be >= 1, got {n_devices}")
     reasons: list[str] = []
+    hbm_source = "caller"
     if hbm_bytes is None:
         hbm_bytes, hbm_src = _device_hbm_bytes(device)
+        hbm_source = "fallback" if "fallback" in hbm_src else "memory_stats"
         reasons.append(f"per-chip HBM {hbm_bytes/1e9:.1f} GB ({hbm_src})")
+    act_src = "caller-measured"
+    if act_bytes is None:
+        # a ledger-measured activation footprint (the trainer's
+        # DSML_MEASURE_ACT wiring) beats the 20-tensors-per-layer guess
+        # below — RESCALED to this plan's batch_per_device (a shrink
+        # re-plan's per-device batch grows; the absolute number measured
+        # at the old geometry would undersize the split), with provenance
+        from dsml_tpu.obs.memory import get_memory_ledger
+
+        ledger_act = get_memory_ledger().activation_bytes_for(batch_per_device)
+        if ledger_act:
+            act_bytes = ledger_act
+            act_src = (f"ledger-measured, rescaled to "
+                       f"batch_per_device={batch_per_device}")
     # disjoint pools so state + activations can never be double-promised
     # against the same bytes: 2/3 of the budget for training state, 1/3 for
     # activations
@@ -221,7 +275,7 @@ def plan_mesh(
     if act_bytes is None and seq_len and d_model and n_layer:
         act_bytes = batch_per_device * seq_len * d_model * n_layer * 20 * param_bytes
     elif act_bytes is not None:
-        reasons.append(f"activation footprint {act_bytes/1e9:.2f} GB (caller-measured)")
+        reasons.append(f"activation footprint {act_bytes/1e9:.2f} GB ({act_src})")
     if act_bytes:
         if act_bytes > act_budget and remaining > 1:
             # smallest sufficient split — the rest stays with dp
@@ -267,4 +321,5 @@ def plan_mesh(
     total = pp * dp * fsdp * sp * tp
     if total != n_devices:
         raise AssertionError(f"planned {total} devices for {n_devices}")  # pragma: no cover
-    return AutoPlan(spec=spec, reasons=tuple(reasons), pp_interleave=interleave)
+    return AutoPlan(spec=spec, reasons=tuple(reasons),
+                    pp_interleave=interleave, hbm_source=hbm_source)

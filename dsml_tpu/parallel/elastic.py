@@ -193,6 +193,13 @@ def _plan_for_survivors(
     rows in the hybrid step). Returns (plan, survivors_used)."""
     cfg = getattr(model, "config", None)
     plan = None
+    overrides = dict(planner_overrides or {})
+    # capacity must be read from a SURVIVOR's memory_stats: plan_mesh's
+    # default device (jax.devices()[0]) can be exactly the chip that just
+    # died — the shrink re-plan would then size the new mesh from a dead
+    # device's (absent) stats and land on the fallback constant
+    if survivors:
+        overrides.setdefault("device", survivors[0])
     for n_use in range(len(survivors), 0, -1):
         candidate = plan_mesh(
             n_devices=n_use,
@@ -202,7 +209,7 @@ def _plan_for_survivors(
             d_model=getattr(cfg, "d_model", 0),
             n_layer=getattr(cfg, "n_layer", 0),
             batch_per_device=batch_per_device,
-            **(planner_overrides or {}),
+            **overrides,
         )
         if global_batch is None or global_batch % (
             candidate.spec.dp * candidate.spec.fsdp

@@ -187,6 +187,14 @@ def init_zero2(
             init_fn, mesh=mesh, in_specs=(P(),), out_specs=specs, check_vma=False
         )
     )(params)
+    # ledger attribution (docs/OBSERVABILITY.md § Memory ledger): the
+    # replicated params vs the axis-sharded optimizer buckets — the n×
+    # ZeRO-2 state saving shows up as the gap between the two claims
+    from dsml_tpu.obs.memory import get_memory_ledger
+
+    ledger = get_memory_ledger()
+    ledger.claim_tree("params", params, detail="zero2")
+    ledger.claim_tree("optimizer", opt_state, detail="zero2")
     return params, opt_state
 
 

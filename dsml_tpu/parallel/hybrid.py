@@ -95,6 +95,26 @@ def hybrid_loss_fn(
     return loss_fn
 
 
+def _with_step_watermark(jitted):
+    """Wrap a jitted hybrid step so every call lands a memory-ledger peak
+    watermark (docs/OBSERVABILITY.md § Memory ledger) — one enabled
+    check when obs is off, one cached stats-availability check on
+    backends without ``memory_stats``. ``.lower`` passes through so
+    compile-introspection callers (memory analysis) keep working."""
+    from dsml_tpu.obs.memory import get_memory_ledger
+
+    ledger = get_memory_ledger()
+
+    def step(params, opt_state, x, y):
+        out = jitted(params, opt_state, x, y)
+        ledger.note_step_peak()
+        return out
+
+    step.lower = jitted.lower
+    step.jitted = jitted
+    return step
+
+
 def make_hybrid_train_step(
     model,
     optimizer: optax.GradientTransformation,
@@ -329,7 +349,7 @@ def make_hybrid_train_step(
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return _with_step_watermark(jax.jit(step, donate_argnums=(0, 1)))
 
     if pp_axis and schedule == "1f1b":
         sharded_grads = jax.shard_map(
@@ -367,7 +387,7 @@ def make_hybrid_train_step(
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    return _with_step_watermark(jax.jit(step, donate_argnums=(0, 1)))
 
 
 def init_hybrid(model, optimizer, mesh: Mesh, seed: int = 0):
@@ -407,4 +427,12 @@ def init_hybrid(model, optimizer, mesh: Mesh, seed: int = 0):
         return leaf
 
     opt_state = jax.tree.map(pin, opt_state)
+    # ledger attribution at the allocation site: per-device SHARD bytes
+    # (an fsdp/pp-sharded state claims what one chip actually holds) —
+    # no-op when obs is off
+    from dsml_tpu.obs.memory import get_memory_ledger
+
+    ledger = get_memory_ledger()
+    ledger.claim_tree("params", params, detail="hybrid")
+    ledger.claim_tree("optimizer", opt_state, detail="hybrid")
     return params, opt_state

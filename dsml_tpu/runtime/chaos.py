@@ -548,6 +548,18 @@ def run_smoke(n_steps: int = 24, seeds: tuple = (), checkpoint_every: int = 4,
             report["recovery_p99_ms"] = round(float(np.percentile(recov, 99)), 3)
             report["recovery_samples"] = len(recov)
         report["goodput_floor"] = SMOKE_GOODPUT_FLOOR
+        # ledger staging audit: after every kill→shrink→grow recovery the
+        # controllers (and their checkpoint writers / any migrators) are
+        # closed — bytes still claimed as staging are a leak, exactly the
+        # class a wedged background commit or an unreleased donor span
+        # produces
+        from dsml_tpu.obs.memory import get_memory_ledger
+
+        led = get_memory_ledger()
+        report["ledger_staging_bytes_final"] = (
+            led.claimed_bytes("checkpoint_staging")
+            + led.claimed_bytes("migration_staging")
+        )
         if serving:
             report["serving"] = _serving_smoke(model, cfg, rng)
             report["serving_fleet"] = _serving_fleet_smoke(model, cfg, rng)
@@ -675,6 +687,12 @@ def _paged_serving_smoke(model, cfg, rng) -> dict:
     workers = list(router.decode_workers) + list(router.prefill_workers)
     baseline_used = [w.used_pages if hasattr(w, "used_pages")
                      else w._pages.used_pages for w in workers]
+    # ledger-level no-leak audit riding alongside the page-count one: the
+    # fleet-wide occupied KV BYTES (live + CoW-shared, every worker's pool
+    # summed through the memory ledger's sources) must return to this
+    # baseline after the drain — a leak that hid page-for-page inside one
+    # pool would still move the byte total
+    ledger_kv_baseline = _ledger_kv_occupied_bytes()
     frids = [router.submit(p, max_new) for p in prompts]
     tick = 0
     peak_shared = 0
@@ -700,6 +718,7 @@ def _paged_serving_smoke(model, cfg, rng) -> dict:
         used = (w.used_pages if hasattr(w, "used_pages")
                 else w._pages.used_pages)
         leaked += max(used - base, 0)
+    ledger_kv_final = _ledger_kv_occupied_bytes()
     report = {
         "requests": len(prompts),
         "token_mismatches": token_loss,
@@ -707,9 +726,26 @@ def _paged_serving_smoke(model, cfg, rng) -> dict:
         "requeued_decode": router.requeued_decode,
         "peak_shared_pages": peak_shared,
         "leaked_pages": leaked,
+        "ledger_kv_baseline_bytes": ledger_kv_baseline,
+        "ledger_kv_final_bytes": ledger_kv_final,
+        "ledger_balanced": int(ledger_kv_final <= ledger_kv_baseline + 0.5),
     }
     report.update(_paged_eviction_leg(model, cfg, rng))
     return report
+
+
+def _ledger_kv_occupied_bytes() -> float:
+    """Fleet-wide OCCUPIED paged-KV bytes (live + CoW-shared) summed over
+    every pool the memory ledger's weakly-held sources still see. GC runs
+    first so a retired batcher's constant contribution cannot shift a
+    baseline-vs-final comparison mid-audit."""
+    import gc
+
+    from dsml_tpu.obs.memory import get_memory_ledger
+
+    gc.collect()
+    claims = get_memory_ledger().claimed().get("kv_pages", {})
+    return float(claims.get("live", 0.0) + claims.get("shared", 0.0))
 
 
 def _paged_eviction_leg(model, cfg, rng) -> dict:
@@ -737,6 +773,10 @@ def _paged_eviction_leg(model, cfg, rng) -> dict:
     srv = ContinuousBatcher(model, params, n_slots=3, prefill_chunk=8,
                             paged_kv="int4", page_size=8, n_pages=8,
                             preemption=True)
+    # ledger baseline AFTER both batchers exist, BEFORE any admission:
+    # ref has drained, srv is empty — the eviction/resume churn below
+    # must return the fleet-wide occupied KV bytes to exactly this
+    ledger_baseline = _ledger_kv_occupied_bytes()
     preempted_rids: set = set()
     evict = srv._evict_slot
 
@@ -754,6 +794,7 @@ def _paged_eviction_leg(model, cfg, rng) -> dict:
         1 for rid, want in zip(rids, ref_tokens)
         if rid in preempted_rids and out.get(rid) == want
     )
+    ledger_final = _ledger_kv_occupied_bytes()
     return {
         "eviction_preemptions": srv.n_preemptions,
         "eviction_swap": srv.n_swap_evictions,
@@ -761,6 +802,9 @@ def _paged_eviction_leg(model, cfg, rng) -> dict:
         "eviction_resumed_identical": resumed_ok,
         "eviction_token_mismatches": mismatches,
         "eviction_leaked_pages": srv.n_pages - 1 - srv.free_pages,
+        "eviction_ledger_baseline_bytes": ledger_baseline,
+        "eviction_ledger_final_bytes": ledger_final,
+        "eviction_ledger_balanced": int(ledger_final <= ledger_baseline + 0.5),
     }
 
 
@@ -1151,6 +1195,13 @@ def verify(report: dict) -> list[str]:
                        f"documented floor {report['goodput_floor']}")
     if not runs:
         bad.append("no chaos runs in the report")
+    staging = report.get("ledger_staging_bytes_final", 0)
+    if staging > 0:
+        bad.append(
+            f"ledger: {staging:.0f} staging byte(s) still claimed after "
+            "every recovery completed — a checkpoint snapshot or migration "
+            "span leaked past its commit"
+        )
     srv = report.get("serving")
     if srv is not None and srv.get("token_mismatches", 0) > 0:
         bad.append(f"serving: {srv['token_mismatches']} request(s) lost or "
@@ -1231,6 +1282,22 @@ def verify(report: dict) -> list[str]:
                 f"serving_paged: {paged['eviction_leaked_pages']} page(s) "
                 "leaked through the preemption tier (swap-out must "
                 "release every reference it takes)"
+            )
+        # ledger-byte balance (ISSUE 15): the fleet-wide occupied KV
+        # BYTES must return to their pre-admission baseline after the
+        # kill leg and after the eviction/resume churn — .get(..., 1)
+        # keeps pre-ledger report files verifiable
+        if not paged.get("ledger_balanced", 1):
+            bad.append(
+                "serving_paged: ledger KV bytes did not return to baseline "
+                f"after the drain ({paged.get('ledger_kv_final_bytes')} vs "
+                f"{paged.get('ledger_kv_baseline_bytes')} baseline)"
+            )
+        if not paged.get("eviction_ledger_balanced", 1):
+            bad.append(
+                "serving_paged: eviction leg leaked ledger KV bytes "
+                f"({paged.get('eviction_ledger_final_bytes')} vs "
+                f"{paged.get('eviction_ledger_baseline_bytes')} baseline)"
             )
     return bad
 

@@ -580,6 +580,14 @@ class ElasticController:
                 "steps discarded by a replay grow-back and re-run",
             ).inc(lost_steps)
         self._goodput.mark("restore", kind=kind)
+        # ledger watermark at the recovery boundary: the re-sharded state
+        # was just re-placed — a postmortem's watermark timeline shows
+        # whether a shrink doubled residency (the _place_state
+        # double-allocation class) or came back to baseline
+        from dsml_tpu.obs.memory import get_memory_ledger
+
+        get_memory_ledger(self._registry).note_step_peak(
+            self._step, label=f"recovery:{kind}")
         rec = {
             "kind": kind, "recovery_ms": round(recovery_ms, 3),
             "from_width": width_before, "to_width": self.spec.n_devices,

@@ -733,6 +733,17 @@ class ContinuousBatcher:
             # (the frozen-SLO-burn-gauge bug class; weakly held — the
             # hook dies with this batcher)
             self._obs.add_collect_hook(self._export_pool_gauges)
+            # memory-ledger source: the pool's device bytes with the
+            # live/shared/free/scratch split, re-read at every scrape and
+            # postmortem (docs/OBSERVABILITY.md § Memory ledger) — weakly
+            # held, so a retired batcher drops out of the ledger
+            self._page_nbytes: float | None = None
+            from dsml_tpu.obs.memory import get_memory_ledger
+
+            get_memory_ledger(self._obs).register_source(
+                "kv_pages", self._ledger_page_bytes,
+                name=f"{self.obs_replica}/{self.obs_role}/{id(self):x}",
+            )
         elif mesh is None:
             self.params = params
             self._cache = model.init_cache(n_slots)
@@ -1516,9 +1527,13 @@ class ContinuousBatcher:
                 labels=("kind", "replica", "role"),
             ).inc(kind=kind, replica=self.obs_replica, role=self.obs_role)
             extra = {"trace_id": req.trace_id} if req.trace_id else {}
+            # the pressure that forced this eviction, measured-headroom
+            # first (memory_pressure) — a postmortem shows whether the
+            # chip or merely the pool sizing was the constraint
             flight_recorder.record(
                 "serving_preempt", rid=req.rid, kind=kind,
-                pos=entry["pos"], **extra,
+                pos=entry["pos"],
+                pressure=round(self.memory_pressure(), 4), **extra,
             )
 
     def _ensure_decode_pages(self, active, width: int):
@@ -2067,10 +2082,70 @@ class ContinuousBatcher:
         /metrics between ticks shows live occupancy, and an idle
         batcher's gauges can never freeze. Reads ``obs_replica`` at call
         time, so a fleet's restamp after spawn is reflected."""
+        if not self._obs.enabled:
+            # collect hooks run even on a disabled registry; every set()
+            # below would no-op anyway — skip the pool reads and the
+            # memory_pressure() device poll outright
+            return
         from dsml_tpu.serving.paging import export_pool_gauges
 
         export_pool_gauges(self._obs, self._pages,
                            self.obs_replica, self.obs_role)
+        self._obs.gauge(
+            "serving_memory_pressure",
+            "device-memory pressure in [0,1]: measured bytes_in_use / "
+            "bytes_limit when the backend reports memory_stats, else the "
+            "pool's allocated-page fraction",
+            labels=("replica", "role"),
+        ).set(self.memory_pressure(), replica=self.obs_replica,
+              role=self.obs_role)
+
+    def _bytes_per_page(self) -> float:
+        """PER-DEVICE bytes of ONE physical page — computed once from the
+        live pool arrays via their addressable shards (so int4 rows, GQA
+        head counts, and tp sharding are all reflected: a tp=2 pool's
+        head-sharded arrays claim what ONE chip holds, not the global
+        nbytes — never re-derived analytically)."""
+        if self._page_nbytes is None:
+            from dsml_tpu.obs.memory import tree_nbytes
+
+            total = tree_nbytes(self._pool, per_device=True)
+            self._page_nbytes = total / max(self.n_pages, 1)
+        return self._page_nbytes
+
+    def _ledger_page_bytes(self) -> dict:
+        """Ledger source body: the pool's device bytes as a disjoint
+        live/shared/free/scratch split (sums to the full pool allocation —
+        the pool buffers are resident whatever the occupancy)."""
+        if not self.paged or self._pool is None:
+            return {}
+        bpp = self._bytes_per_page()
+        shared = self._pages.shared_pages
+        return {
+            "live": (self._pages.used_pages - shared) * bpp,
+            "shared": shared * bpp,
+            "free": self._pages.free_pages * bpp,
+            "scratch": bpp,
+        }
+
+    def memory_pressure(self) -> float:
+        """Device-memory pressure in [0, 1] — the preemption tier's and
+        the autoscaler's signal. MEASURED when the backend reports
+        ``memory_stats`` (bytes_in_use / bytes_limit: the whole chip,
+        params and XLA temps included — the number an eviction decision
+        actually competes against), falling back to the pool's
+        allocated-page fraction on statless backends (virtual-CPU tests:
+        identical behavior to the page-count era)."""
+        if not self.paged:
+            return 0.0
+        from dsml_tpu.obs.memory import get_memory_ledger
+
+        measured = get_memory_ledger(self._obs).measure()
+        if measured["available"] and measured.get("bytes_limit"):
+            return min(max(
+                measured["bytes_in_use"] / measured["bytes_limit"], 0.0), 1.0)
+        allocatable = max(self.n_pages - 1, 1)
+        return (allocatable - self._pages.free_pages) / allocatable
 
     def _step_inner(self) -> dict[int, list]:
         emitted: dict[int, list] = {}

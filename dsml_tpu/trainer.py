@@ -10,6 +10,7 @@ on-device, and forward/backward/update run as one donated jitted step.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 
 import jax
@@ -19,6 +20,7 @@ import optax
 
 from dsml_tpu.obs import GoodputTracker, StepBreakdown, get_registry
 from dsml_tpu.obs import flight_recorder, hangwatch
+from dsml_tpu.obs.memory import get_memory_ledger, maybe_dump_oom
 from dsml_tpu.obs.sentinels import TrainingSentinels
 from dsml_tpu.parallel.dp import make_dp_train_step, make_eval_step
 from dsml_tpu.parallel.mesh import data_mesh
@@ -143,8 +145,6 @@ class Trainer:
             ckpt = CheckpointManager(cfg.checkpoint_dir,
                                      max_to_keep=cfg.keep_checkpoints)
             if cfg.resume and ckpt.latest_step() is None:
-                import os
-
                 foreign = [n for n in os.listdir(ckpt.directory) if n.isdigit()]
                 if foreign:
                     # digit-named step dirs = the orbax layout the previous
@@ -204,10 +204,13 @@ class Trainer:
         sentinels = TrainingSentinels.maybe_from_env()
         hw_cfg = hangwatch.config_from_env()
         hw = hangwatch.get_hangwatch() if hw_cfg is not None else None
-        if sentinels is not None or hw is not None:
+        measure_act = os.environ.get("DSML_MEASURE_ACT") == "1"
+        if sentinels is not None or hw is not None or measure_act:
             # forensic env opt-in IMPLIES observability: a halt bundle with
             # empty event/metric/log sections would defeat the black-box
-            # recorder the operator just asked for. Enable the registry and
+            # recorder the operator just asked for (and a measured
+            # activation claim on a disabled registry would vanish before
+            # plan_mesh could read it). Enable the registry and
             # install the crash/SIGTERM dump hooks + the log ring
             # (idempotent; previous hooks are chained, obs.disable restores)
             from dsml_tpu.utils.logging import install_ring_handler
@@ -218,6 +221,21 @@ class Trainer:
         track = obs_reg.enabled
         goodput = GoodputTracker(registry=obs_reg) if track else None
         breakdown = StepBreakdown(registry=obs_reg) if track else None
+        ledger = get_memory_ledger(obs_reg)
+        if track:
+            # memory ledger (docs/OBSERVABILITY.md § Memory ledger):
+            # attribute the training state at its allocation site — the
+            # per-device resident bytes of params / optimizer state / EF
+            # residuals; per-step peak watermarks land at loss syncs below
+            ledger.claim_tree("params", params)
+            ledger.claim_tree("optimizer", opt_state)
+            if ef is not None:
+                ledger.claim_tree("error_feedback", ef)
+        if measure_act:
+            self._measure_activation_footprint(
+                params, data.train_x[: cfg.batch_size],
+                data.train_y[: cfg.batch_size], ledger, recorder,
+            )
         if track and start_epoch > 1:
             goodput.mark("restore", epoch=start_epoch - 1)
         step_deadline = (hangwatch.TrailingDeadline.from_config(hw_cfg)
@@ -319,6 +337,11 @@ class Trainer:
                             losses[-1].block_until_ready()
                             if track:
                                 breakdown.add("loss_sync", time.perf_counter() - t_disp)
+                                # per-step peak watermark at the existing
+                                # sync point (the step already blocked —
+                                # no new device round trips; statless
+                                # backends record the claimed total)
+                                ledger.note_step_peak(global_step)
                             if hw is not None:
                                 if hw_token is not None:
                                     hw.disarm(hw_token)
@@ -399,6 +422,17 @@ class Trainer:
                 if last_epoch >= start_epoch and last_epoch % max(cfg.save_every, 1) != 0:
                     save_ckpt(last_epoch, last_epoch, 0, wait=True)
             train_body_done = True
+        except BaseException as e:
+            # a device OOM unwinding through here leaves a postmortem
+            # whose memory.json carries the ledger snapshot + watermark
+            # timeline (docs/OBSERVABILITY.md § Memory ledger); any other
+            # exception passes untouched (the crash hooks own those)
+            if track:
+                try:
+                    maybe_dump_oom(e)
+                except Exception:  # noqa: BLE001 — never mask the real crash
+                    pass
+            raise
         finally:
             if ckpt is not None:
                 # ALWAYS flush: a dying run (preemption signal unwinding,
@@ -430,6 +464,42 @@ class Trainer:
             final["obs_step_breakdown"] = breakdown.summary()
         self.metrics.log(**final)
         return params, history, test_acc
+
+    def _measure_activation_footprint(self, params, x, y, ledger,
+                                      recorder) -> None:
+        """``DSML_MEASURE_ACT=1``: measure the train step's XLA temp bytes
+        from shapes alone (``parallel.auto.measured_activation_bytes`` —
+        compile-only, no data, no execution) and claim them as the
+        ledger's ``activations`` subsystem, so the activation-budget
+        number ``plan_mesh`` consumes exists without a manual call. The
+        extra compile is the opt-in's price; failure logs and trains on —
+        a broken measurement must never block the run it instruments."""
+        from dsml_tpu.parallel.auto import measured_activation_bytes
+
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        try:
+            measured = measured_activation_bytes(
+                self.model.loss, jax.tree.map(sds, params), sds(x), sds(y)
+            )
+        except Exception:
+            log.warning("DSML_MEASURE_ACT: activation measurement failed",
+                        exc_info=True)
+            return
+        if measured is None:
+            log.warning(
+                "DSML_MEASURE_ACT: backend reports no compiled memory "
+                "analysis — activation footprint stays analytic"
+            )
+            return
+        # claim + geometry: plan_mesh rescales per-sample to ITS
+        # batch_per_device instead of reusing this absolute number
+        ledger.record_activation_measurement(measured, x.shape[0])
+        recorder.record("activation_measured", bytes=int(measured),
+                        batch=int(x.shape[0]))
+        log.info("measured activation footprint: %.2f MB (XLA temp bytes "
+                 "of the compiled step)", measured / 1e6)
 
     def evaluate(self, params, x: np.ndarray, y: np.ndarray, batch_size: int = 2048,
                  progress_label: str | None = None) -> float:

@@ -450,6 +450,75 @@ def test_long_context_section_schema(monkeypatch):
 
 
 @pytest.mark.slow
+def test_memory_section_schema(monkeypatch):
+    """The BENCH `memory` section's contract (ISSUE 15 acceptance): ledger
+    attribution pins exactly against hand-counted per-device bytes, the
+    injected-stats reconciliation self-check's residual math is exact, the
+    disabled-mode ledger bundle stays under the 1% bar, an injected
+    RESOURCE_EXHAUSTED leaves a postmortem whose memory.json carries the
+    snapshot + watermark timeline, the analytic-vs-compiler-measured rung
+    cross-check is monotone, and the fleet merge orders headroom
+    min/mean/max. Runs the TINY ladder (the CI smoke step's) — slow tier:
+    the subprocess compiles a step per rung."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    monkeypatch.setenv("DSML_MEMORY_TINY", "1")
+    rows = bench.bench_memory()
+
+    assert "memory_error" not in rows, rows
+
+    # (a) attribution math pinned: claims == hand-counted per-device bytes
+    assert rows["memory_attribution_params_ok"] == 1
+    assert rows["memory_attribution_optimizer_ok"] == 1
+    assert rows["memory_claimed_params_bytes"] > 0
+    # adam m/v double the param bytes (plus replicated scalars)
+    assert rows["memory_claimed_optimizer_bytes"] >= \
+        2 * rows["memory_claimed_params_bytes"]
+    # the wrapped hybrid step recorded one watermark per step, source-
+    # stamped (CPU backends report no stats → "claimed" provenance)
+    assert rows["memory_step_watermarks"] == 3
+    assert rows["memory_step_peak_bytes"] > 0
+    assert rows["memory_watermark_source"] in ("claimed", "memory_stats")
+
+    # (b) reconciliation: the self-check's residual math is EXACT, and on
+    # stats-reporting backends the live residual honors the documented
+    # bound (CPU: provenance says unavailable, the row is absent)
+    assert rows["memory_selfcheck_ok"] == 1
+    assert rows["memory_selfcheck_residual_bytes"] == \
+        rows["memory_selfcheck_expected_residual_bytes"]
+    if rows["memory_stats_available"]:
+        assert rows["memory_reconcile_residual_pct"] <= \
+            rows["memory_reconcile_bound_pct"]
+
+    # (c) analytic-vs-measured rung cross-check: both columns exist per
+    # rung and the compiler-measured temps grow with the rung
+    assert rows["memory_rung_monotonic_ok"] == 1
+    assert rows["memory_rung1024_analytic_act_bytes"] > 0
+    assert rows["memory_rung1024_measured_temp_bytes"] > 0
+    assert rows["memory_rung1024_measured_over_analytic"] > 0
+
+    # (d) the disabled-mode bar — same <1%-of-a-fused-step contract as
+    # every other obs subsystem
+    assert rows["memory_disabled_overhead_pct"] < 1.0
+    assert rows["memory_disabled_bundle_ns"] > 0
+
+    # (e) OOM forensics: the bundle names the reason and carries a
+    # complete ledger snapshot + the watermark timeline
+    assert rows["memory_oom_reason_ok"] == 1
+    assert rows["memory_oom_snapshot_ok"] == 1
+    assert rows["memory_oom_watermarks"] >= 3
+    assert {"memory.json", "registry.json", "events.jsonl",
+            "stacks.txt"} <= set(rows["memory_oom_bundle_files"])
+
+    # (f) fleet merge: headroom min/mean/max over both synthetic hosts
+    assert rows["memory_fleet_headroom_ok"] == 1
+    assert rows["memory_fleet_headroom_min_gb"] < \
+        rows["memory_fleet_headroom_max_gb"]
+    assert rows["memory_fleet_unattributed_rows"] == 2
+
+
+@pytest.mark.slow
 def test_cpu_fallback_emits_under_hung_probe():
     """The capped-preflight path: probe hangs, preflight gives up inside its
     cap, and the CPU fallback still measures mnist and emits — the shape

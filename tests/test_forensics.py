@@ -267,7 +267,8 @@ def test_bundle_schema_round_trip(tmp_path):
     names = sorted(os.listdir(path))
     assert names == [
         "MANIFEST.json", "events.jsonl", "fingerprint.json",
-        "log_tail.jsonl", "registry.json", "stacks.txt", "trace.json",
+        "log_tail.jsonl", "memory.json", "registry.json", "stacks.txt",
+        "trace.json",
     ]
     manifest = json.load(open(os.path.join(path, "MANIFEST.json")))
     assert manifest["reason"] == "schema_check"
@@ -286,6 +287,11 @@ def test_bundle_schema_round_trip(tmp_path):
     assert fp["pid"] == os.getpid() and "python" in fp
     stacks = open(os.path.join(path, "stacks.txt")).read()
     assert "MainThread" in stacks
+    # the memory ledger snapshot rides every bundle (resolved through
+    # THIS recorder's registry — a private recorder gets its own ledger)
+    mem = json.load(open(os.path.join(path, "memory.json")))
+    assert mem["schema"] == "dsml.obs.memory_ledger/1"
+    assert "claimed_total_bytes" in mem and "watermarks" in mem
 
 
 def test_dump_with_exception_records_traceback(tmp_path):

@@ -118,6 +118,22 @@ def test_truncated_trailing_number_is_rejected():
     ("request_tracing_batch_goodput_requests", None),
     # "_trace_us" is scoped so forensics' single-shot µs row stays ungated
     ("forensics_enabled_bundle_us", None),
+    # memory section (ISSUE 15): peak watermarks and the unattributed
+    # residual gate DOWN-GOOD despite the generic "_bytes" exemption
+    # (a peak is a measurement, not a schedule count); bytes_limit is
+    # the chip, claimed-taxonomy rows are attribution bookkeeping, and
+    # availability flags are structure — never gated
+    ("memory_step_peak_bytes", "lower"),
+    ("hbm_peak_bytes_in_use", "lower"),
+    ("memory_unattributed_bytes", "lower"),
+    ("memory_disabled_overhead_pct", "lower"),
+    ("hbm_bytes_limit", None),
+    ("memory_claimed_params_bytes", None),
+    ("memory_stats_available", None),
+    ("memory_rung2048_measured_temp_bytes", None),  # compiler count
+    ("memory_selfcheck_expected_residual_bytes", None),
+    ("memory_oom_watermarks", None),
+    ("memory_fleet_unattributed_rows", None),  # process count, not drift
 ])
 def test_direction_table(name, want):
     assert metric_direction(name) == want
