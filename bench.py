@@ -3936,6 +3936,194 @@ def _paged_attention_main() -> None:
     print(json.dumps(out))
 
 
+def bench_kernel_fusion() -> dict:
+    """Deep-fusion section (docs/TUNING.md § Kernel fusion, PR 16): the
+    three env-gated fusions A/B'd against their parity oracles. Rows:
+    decode-tick p50 with the double-buffered paged kernel vs the
+    single-buffer kernel at rising live-page fraction, per-hop ring
+    walls fused (sendahead) vs unfused plus the analytic MXU-idle
+    fraction the fusion exists to close, the weight-byte compression
+    rows (>=3.9x int8 / >=7.8x int4 at d=768 — the acceptance floors),
+    and bit-identity verdicts for all three fusions. Virtual-8 CPU
+    subprocess: both paged kernels run INTERPRETED off-TPU and the
+    in-ring hop lowers to the same ppermute schedule, so every
+    DMA-overlap row carries an explicit provenance label
+    ("interpret"/"analytic") — the overlap win itself needs real chips
+    (the ROADMAP evidence sweep's kernel_fusion leg)."""
+    code = "import bench; bench._kernel_fusion_main()"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, cwd=".",
+            timeout=max(min(600.0, _budget_left()), 120.0),
+        )
+        if proc.returncode != 0 or not proc.stdout.strip():
+            return {
+                "kernel_fusion_error": (
+                    f"rc={proc.returncode}; stderr tail: {proc.stderr[-300:]}"
+                )
+            }
+        res = json.loads(proc.stdout.strip().splitlines()[-1])
+        out = {f"kernel_fusion_{k}": v for k, v in res.items()}
+        out["kernel_fusion_note"] = (
+            "virtual-8 CPU: bit-identity verdicts, compression floors and "
+            "analytic idle accounting are the signal; interpret-mode tick "
+            "walls execute DMAs synchronously, so the pipelined-vs-single "
+            "and fused-vs-unfused wall deltas only mean anything on chips"
+        )
+        return out
+    except Exception as e:  # never fail the bench on the secondary section
+        return {"kernel_fusion_error": repr(e)[:200]}
+
+
+def _kernel_fusion_main() -> None:
+    """Subprocess entry for :func:`bench_kernel_fusion`.
+    ``DSML_KERNEL_FUSION_TINY=1`` shrinks the workload for CI smoke."""
+    import numpy as np
+
+    from dsml_tpu.utils.platform import configure_platform
+
+    configure_platform("cpu", 8)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.ops.attention import attention
+    from dsml_tpu.ops.paged_attention import paged_vmem_bytes
+    from dsml_tpu.ops.quantization import quantize_weight_blocks
+    from dsml_tpu.ops.ring_attention import (
+        causal_keep_fraction, ring_attention, ring_kv_wire_bytes,
+    )
+    from dsml_tpu.serving import ContinuousBatcher
+
+    tiny = os.environ.get("DSML_KERNEL_FUSION_TINY", "").lower() not in (
+        "", "0", "false", "off"
+    )
+    out: dict = {"tiny": int(tiny)}
+
+    # ---- (1) paged double buffering: decode-tick p50 pipelined vs
+    # single-buffer at rising live fraction. Off-TPU both kernels
+    # INTERPRET (DMAs synchronous): provenance below says so ----
+    cfg = GPT2Config(vocab_size=256, max_seq=128, n_layer=1, n_head=4,
+                     d_model=64, d_ff=128)
+    model = GPT2(cfg)
+    params = model.init(0)
+    page_size = 16
+    n_slots = 2
+    rng = np.random.default_rng(0)
+    out["page_size"] = page_size
+    out["n_slots"] = n_slots
+    out["dma_overlap_provenance"] = "interpret"
+    max_new = 4
+    fracs = (25,) if tiny else (25, 100)
+    for frac in fracs:
+        depth = max(int(cfg.max_seq * frac / 100) - max_new - 1, 8)
+        prompts = [rng.integers(1, cfg.vocab_size, depth).astype(np.int32)
+                   for _ in range(n_slots)]
+        for pipe, tag in (("0", "single"), ("1", "pipelined")):
+            os.environ["DSML_PAGED_ATTN"] = "pallas"
+            os.environ["DSML_PAGED_ATTN_PIPELINE"] = pipe
+            try:
+                b = ContinuousBatcher(
+                    model, params, n_slots=n_slots, prefill_chunk=32,
+                    paged_kv="int4", page_size=page_size,
+                    n_pages=n_slots * cfg.max_seq // page_size + 1)
+                for p in prompts:
+                    b.submit(p, max_new)
+                while b.n_pending or b.n_queued:  # compile off-clock
+                    b.step()
+                walls = []
+                while b.n_active:
+                    t0 = time.monotonic()
+                    b.step()
+                    walls.append(time.monotonic() - t0)
+                b.collect()
+            finally:
+                os.environ.pop("DSML_PAGED_ATTN", None)
+                os.environ.pop("DSML_PAGED_ATTN_PIPELINE", None)
+            out[f"tick_p50_ms_live{frac}_{tag}"] = round(
+                float(np.percentile(walls, 50)) * 1e3, 3)
+        _bump_progress()
+    # the analytic overlap claim the interpreter can't show: the slot
+    # ring keeps the NEXT page's DMA in flight during this page's math,
+    # at a VMEM working set the budget guard sizes (the "_bytes" rows
+    # are structure, never perf-gated)
+    hd = cfg.d_model // cfg.n_head
+    out["paged_vmem_pipelined_bytes"] = paged_vmem_bytes(
+        page_size, hd, "int4", pipeline=True)
+    out["paged_vmem_single_bytes"] = paged_vmem_bytes(
+        page_size, hd, "int4", pipeline=False)
+
+    # ---- (2) in-ring fused KV hop: per-hop wall fused (sendahead) vs
+    # unfused on the virtual cp=4 mesh + the analytic MXU-idle fraction
+    # the fusion closes on chips. CPU lowers both schedules to the same
+    # ppermute program, hence the analytic label ----
+    cp, s, h, hdr = 4, (128 if tiny else 256), 2, 16
+    mesh = Mesh(np.asarray(jax.devices()[:cp]).reshape(cp), ("cp",))
+    spec = P(None, None, "cp", None)
+    qkv = [jnp.asarray(rng.standard_normal((1, h, s, hdr)), jnp.float32)
+           for _ in range(3)]
+
+    def ring_fn(fused):
+        return jax.jit(jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, "cp", True, fused=fused),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+            check_vma=False))
+
+    hops = cp - 1
+    ring_rows = {}
+    for fused, tag in ((None, "unfused"), ("sendahead", "fused")):
+        fn = ring_fn(fused)
+        ring_rows[tag] = np.asarray(fn(*qkv))  # compile + parity capture
+        reps = 3 if tiny else 5
+        t0 = time.monotonic()
+        for _ in range(reps):
+            jax.block_until_ready(fn(*qkv))
+        wall = (time.monotonic() - t0) / reps
+        # per hop, both directions together (the bidirectional ring runs
+        # 2 streams of cp-1 hops concurrently)
+        out[f"ring_hop_ms_{tag}"] = round(wall / hops * 1e3, 3)
+    out["ring_fused_bit_identical_ok"] = int(
+        np.array_equal(ring_rows["fused"], ring_rows["unfused"]))
+    out["ring_hop_provenance"] = "analytic"
+    # analytic MXU-idle fraction per hop on chips: the exposed hop is the
+    # KV shard's wire time; fused, it hides behind the hop's flash math —
+    # report the exposed fraction the unfused schedule leaves idle
+    # assuming compute-bound hops (v4 ICI ~50 GB/s/link, MXU at the flash
+    # kernel's measured ~40% MFU — the labels matter, not the constants)
+    wire = ring_kv_wire_bytes(s // cp, cp, h, hdr) / hops  # bytes per hop
+    flops_hop = 4 * 1 * h * (s // cp) * s * hdr * causal_keep_fraction(cp)
+    ici_s = wire / 50e9
+    mxu_s = flops_hop / (275e12 * 0.4)
+    out["ring_mxu_idle_frac_unfused_analytic"] = round(
+        ici_s / (ici_s + mxu_s), 4)
+    out["ring_mxu_idle_frac_fused_analytic"] = 0.0
+    _bump_progress()
+
+    # ---- (3) dequant-fused weights: compression rows at real dims
+    # (d=768 — the acceptance floors) + kernel-vs-oracle parity ----
+    from dsml_tpu.ops.quantization import (
+        dequantize_weight_blocks, quantized_matmul,
+    )
+
+    w = jnp.asarray(rng.standard_normal((768, 768)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((8, 768)), jnp.float32)
+    parity = True
+    for scheme in ("int8", "int4"):
+        qwt = quantize_weight_blocks(w, scheme)
+        out[f"weight_compression_{scheme}"] = round(
+            qwt.dense_bytes / qwt.hbm_bytes, 2)
+        got = np.asarray(quantized_matmul(x, qwt))
+        ref = np.asarray(x @ dequantize_weight_blocks(qwt))
+        err = float(np.max(np.abs(got - ref)) /
+                    max(float(np.max(np.abs(ref))), 1e-9))
+        parity = parity and err < 1e-5
+    out["weight_fused_parity_ok"] = int(parity)
+    out["weight_quant_provenance"] = "interpret"
+    print(json.dumps(out))
+
+
 def bench_cluster() -> dict:
     """Cluster-observability section (``docs/OBSERVABILITY.md`` § Cluster):
 
@@ -4477,6 +4665,10 @@ _SECTIONS = {
     "paged_attention": bench_paged_attention,  # Pallas paged kernel vs XLA
     #                     gather: analytic live-vs-table HBM A/B, parity +
     #                     tp=2 capacity + eviction verdicts; virtual-8
+    "kernel_fusion": bench_kernel_fusion,  # deep-fusion A/B: pipelined
+    #                     paged DMA, in-ring fused KV hop, dequant-fused
+    #                     matmuls — bit-identity + compression floors +
+    #                     analytic idle accounting; virtual-8
     "cluster": bench_cluster,  # aggregation-plane overhead + regress gate
     "migration": bench_migration,  # P2P shard-motion MB/s + recovery split
     "long_context": bench_long_context,  # cp=8 ring-attention ladder to 128k

@@ -10,8 +10,8 @@ import numpy as np
 
 __all__ = [
     "he_init", "softmax_xent", "count_correct", "with_fsdp", "fsdp_spec_fn",
-    "quantize_weights_int8", "maybe_dequant",
-    "transformer_train_flops", "mlp_train_flops",
+    "quantize_weights_int8", "quantize_weights_blocked", "maybe_dequant",
+    "qmatmul", "transformer_train_flops", "mlp_train_flops",
 ]
 
 
@@ -95,6 +95,39 @@ def quantize_weights_int8(params: dict) -> dict:
     }
 
 
+def quantize_weights_blocked(params: dict, scheme: str = "int8",
+                             block: int | None = None) -> dict:
+    """Serving weight quantization for the DEQUANT-FUSED kernel path: the
+    same leaf selection as :func:`quantize_weights_int8`, but each matmul
+    weight becomes an ``ops.quantization.QuantizedWeight`` — nibble-packed
+    int4 or int8 codes with one f32 scale per (k-block, output channel) —
+    consumed by :func:`qmatmul`, which runs the Pallas dequant-fused
+    matmul (``quantized_matmul``) instead of letting XLA expand the
+    weight. HBM holds the weights at ~4× (int8) / ~8× (int4) under f32
+    and the full-width form only ever exists one VMEM tile at a time.
+    Same scope limits: single-device serving surfaces only (TP shard_map
+    paths expect plain leaves matching ``param_specs``)."""
+    from dsml_tpu.ops.quantization import quantize_weight_blocks
+
+    def quant_layer(layer: dict) -> dict:
+        out = {}
+        for group, leaves in layer.items():
+            if group in ("attn", "mlp") and isinstance(leaves, dict):
+                out[group] = {
+                    k: (quantize_weight_blocks(v, scheme, block)
+                        if k in _WQ_KEYS else v)
+                    for k, v in leaves.items()
+                }
+            else:
+                out[group] = leaves
+        return out
+
+    return {
+        k: ([quant_layer(l) for l in v] if k == "layers" else v)
+        for k, v in params.items()
+    }
+
+
 def maybe_dequant(w, dtype=None):
     """Matmul-site hook for weight-only int8: plain arrays pass through;
     ``{"qw", "qs"}`` leaves dequantize into the requested dtype (default
@@ -104,6 +137,29 @@ def maybe_dequant(w, dtype=None):
         dt = dtype or jnp.float32
         return w["qw"].astype(dt) * w["qs"].astype(dt)
     return w
+
+
+def qmatmul(x, w, dtype=None):
+    """THE matmul-site dispatcher for every weight codec the serving path
+    carries: plain arrays and per-channel ``{"qw","qs"}`` dicts keep their
+    exact pre-existing lowering (``@`` / einsum on ``maybe_dequant`` — the
+    w8a16 fast path), while block-quantized ``QuantizedWeight`` leaves
+    route to the Pallas dequant-fused matmul, contracting ``x``'s last
+    axis against the weight's first and restoring the weight's trailing
+    axes (GPT-2's fused ``wqkv [d, 3, d]`` comes back ``[..., 3, d]``, so
+    the einsum call site needs no special casing)."""
+    from dsml_tpu.ops.quantization import QuantizedWeight, quantized_matmul
+
+    if isinstance(w, QuantizedWeight):
+        lead = x.shape[:-1]
+        out = quantized_matmul(x.reshape(-1, x.shape[-1]), w)
+        return out.reshape(*lead, *w.shape[1:]).astype(dtype or x.dtype)
+    w = maybe_dequant(w, dtype)
+    if w.ndim == 3:
+        # the fused-QKV form: [b, s, d] · [d, slots, d] — kept as the
+        # einsum the site always compiled to
+        return jnp.einsum("bsd,dke->bske", x, w)
+    return x @ w
 
 
 def with_fsdp(spec, shape: tuple, fsdp: int, axis: str = "fsdp"):

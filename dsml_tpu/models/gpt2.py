@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from dsml_tpu.models.common import fsdp_spec_fn, maybe_dequant
+from dsml_tpu.models.common import fsdp_spec_fn, maybe_dequant, qmatmul
 from dsml_tpu.ops.attention import _NEG_INF, attention, ring_attention, ulysses_attention
 
 __all__ = ["GPT2Config", "GPT2"]
@@ -510,14 +510,14 @@ class GPT2:
         x = _layer_norm(h, **layer["ln_1"])
         q, k, v = self._qkv_heads(layer, x, n_head_local)
         out = self._route_attention(q, k, v, sp_axis, attn_impl)
-        out = self._merge_heads(out) @ maybe_dequant(layer["attn"]["wo"], out.dtype)  # row-parallel → partial sums
+        out = qmatmul(self._merge_heads(out), layer["attn"]["wo"], out.dtype)  # row-parallel → partial sums
         if tp_axis:
             out = lax.psum(out, tp_axis)  # Megatron psum #1
         return out + layer["attn"]["bo"]
 
     def _mlp_block(self, mlp, x, tp_axis):
-        hmid = jax.nn.gelu(x @ maybe_dequant(mlp["w_in"], x.dtype) + mlp["b_in"])  # [b, s, d_ff/tp]
-        out = hmid @ maybe_dequant(mlp["w_out"], x.dtype)
+        hmid = jax.nn.gelu(qmatmul(x, mlp["w_in"], x.dtype) + mlp["b_in"])  # [b, s, d_ff/tp]
+        out = qmatmul(hmid, mlp["w_out"], x.dtype)
         if tp_axis:
             out = lax.psum(out, tp_axis)  # Megatron psum #2
         return out + mlp["b_out"]
@@ -1039,7 +1039,7 @@ class GPT2:
         last dim is purely a head split; ``n_head_local`` is the head count
         actually present in this shard (full ``n_head`` when unsharded)."""
         n_head_local = n_head_local or self.config.n_head
-        qkv = jnp.einsum("bsd,dke->bske", x, maybe_dequant(layer["attn"]["wqkv"], x.dtype)) + layer["attn"]["bqkv"]
+        qkv = qmatmul(x, layer["attn"]["wqkv"], x.dtype) + layer["attn"]["bqkv"]
 
         def heads(t):  # [b, s, d_local] -> [b, h_local, s, hd]
             b, s, _ = t.shape
@@ -1170,7 +1170,7 @@ class GPT2:
                 if use_flash
                 else attention(q, ka, va, causal=True)
             )
-            attn_out = self._merge_heads(out) @ maybe_dequant(layer["attn"]["wo"], h.dtype)
+            attn_out = qmatmul(self._merge_heads(out), layer["attn"]["wo"], h.dtype)
             if tp_axis:
                 attn_out = lax.psum(attn_out, tp_axis)
             h = h + attn_out + self._attn_out_bias(layer)
@@ -1209,7 +1209,7 @@ class GPT2:
             c = self._cache_write(c, kc, vc, write)
             ck, cv, k_s, v_s = self._cache_attn_inputs(c)
             out = self._decode_attention(q, ck, cv, valid, k_s, v_s)
-            attn_out = self._merge_heads(out) @ maybe_dequant(layer["attn"]["wo"], h.dtype)
+            attn_out = qmatmul(self._merge_heads(out), layer["attn"]["wo"], h.dtype)
             if tp_axis:
                 attn_out = lax.psum(attn_out, tp_axis)
             h = h + attn_out + self._attn_out_bias(layer)
@@ -1470,7 +1470,14 @@ class GPT2:
         mask."""
         from dsml_tpu.ops.paged_attention import paged_attention, paged_attn_impl
 
-        use_pallas = paged_attn_impl() == "pallas"
+        # pass the page geometry so the router can veto a working set that
+        # would blow the VMEM budget (falls back to the XLA gather with a
+        # warn-once instead of dying inside Mosaic at compile time)
+        use_pallas = paged_attn_impl(
+            page_size=pool[0]["k"].shape[2],
+            head_dim=self.config.d_model // self.config.n_head,
+            mode=mode,
+        ) == "pallas"
         b_q, c_q = h.shape[0], h.shape[1]
         posq = jnp.broadcast_to(
             jnp.atleast_2d(jnp.asarray(positions, jnp.int32)), (b_q, c_q)
@@ -1486,7 +1493,7 @@ class GPT2:
             else:
                 ck, cv, k_s, v_s = self._paged_attn_inputs(c, page_table, mode)
                 out = self._decode_attention(q, ck, cv, valid, k_s, v_s)
-            attn_out = self._merge_heads(out) @ maybe_dequant(layer["attn"]["wo"], h.dtype)
+            attn_out = qmatmul(self._merge_heads(out), layer["attn"]["wo"], h.dtype)
             if tp_axis:
                 attn_out = lax.psum(attn_out, tp_axis)
             h = h + attn_out + self._attn_out_bias(layer)

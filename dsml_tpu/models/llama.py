@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from dsml_tpu.models.common import maybe_dequant
+from dsml_tpu.models.common import maybe_dequant, qmatmul
 from dsml_tpu.models.gpt2 import GPT2
 from dsml_tpu.ops.attention import _NEG_INF
 
@@ -283,9 +283,9 @@ class Llama(GPT2):
             b, s, _ = t.shape
             return t.reshape(b, s, n, hd).transpose(0, 2, 1, 3)
 
-        q = heads(x @ maybe_dequant(layer["attn"]["wq"], x.dtype), n_head_local)
-        k = heads(x @ maybe_dequant(layer["attn"]["wk"], x.dtype), n_kv_local)
-        v = heads(x @ maybe_dequant(layer["attn"]["wv"], x.dtype), n_kv_local)
+        q = heads(qmatmul(x, layer["attn"]["wq"], x.dtype), n_head_local)
+        k = heads(qmatmul(x, layer["attn"]["wk"], x.dtype), n_kv_local)
+        v = heads(qmatmul(x, layer["attn"]["wv"], x.dtype), n_kv_local)
         q = _rope(q, positions, self.config.rope_theta)
         k = _rope(k, positions, self.config.rope_theta)
         repeat = n_head_local // n_kv_local
@@ -304,7 +304,7 @@ class Llama(GPT2):
         x = _rms_norm(h, layer["rms_1"]["scale"], cfg.rms_eps)
         q, _, _, ka, va = self._qkv_gqa(layer, x, n_head_local, n_kv_local, positions)
         out = self._route_attention(q, ka, va, sp_axis, attn_impl)
-        out = self._merge_heads(out) @ maybe_dequant(layer["attn"]["wo"], out.dtype)
+        out = qmatmul(self._merge_heads(out), layer["attn"]["wo"], out.dtype)
         if tp_axis:
             out = lax.psum(out, tp_axis)
         h = h + out
@@ -312,8 +312,8 @@ class Llama(GPT2):
         return h
 
     def _mlp_block(self, mlp, x, tp_axis):
-        mid = jax.nn.silu(x @ maybe_dequant(mlp["w_gate"], x.dtype)) * (x @ maybe_dequant(mlp["w_up"], x.dtype))  # [b, s, ff/tp]
-        out = mid @ maybe_dequant(mlp["w_down"], x.dtype)
+        mid = jax.nn.silu(qmatmul(x, mlp["w_gate"], x.dtype)) * qmatmul(x, mlp["w_up"], x.dtype)  # [b, s, ff/tp]
+        out = qmatmul(mid, mlp["w_down"], x.dtype)
         if tp_axis:
             out = lax.psum(out, tp_axis)  # Megatron psum #2
         return out

@@ -80,6 +80,7 @@ SUBSYSTEMS = (
     "optimizer",           # optimizer state (adam m/v, ZeRO-2 shards)
     "error_feedback",      # quantized-sync EF residuals (per-rank shards)
     "kv_pages",            # paged KV pool (live/shared/free/scratch split)
+    "weights_quant",       # block-quantized serving weights (packed/scales)
     "migration_staging",   # P2P shard-motion staging spans in flight
     "checkpoint_staging",  # async-writer host snapshots awaiting commit
     "activations",         # XLA step temps (measured_activation_bytes)

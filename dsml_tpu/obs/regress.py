@@ -204,6 +204,17 @@ _NOT_A_METRIC = (
     # structure, not perf (the residual/overhead rows gate through the
     # explicit memory rules in metric_direction below)
     "stats_available", "_watermarks", "memory_oom_", "expected_",
+    # kernel_fusion section: the weight-byte compression ratios are
+    # analytic codec constants the contract test pins against the
+    # acceptance floors (a moved ratio is a codec change, not a
+    # noise-band question), the MXU-idle fractions are analytic labels
+    # (no rule matches them — ungated by default), and the provenance
+    # rows are strings the flattener never sees. The
+    # tick_p50_ms_live*_{single,pipelined} walls gate down-good via the
+    # "tick_p50" contains rule and the ring_hop_ms_{fused,unfused}
+    # walls via the "hop_ms" contains rule below (the _ms SUFFIX rule
+    # misses the trailing schedule tag).
+    "compression",
     # long_context section: ladder geometry + analytic accounting rows.
     # The KV wire-byte rows are EXACT schedule counts (the generic "_bytes"
     # rule above already exempts them — a changed count is a schedule
@@ -240,7 +251,12 @@ _LOWER_BETTER_CONTAINS = ("loss", "overhead", "stall", "latency", "ttft",
                           # per-live-fraction decode-tick walls
                           # (tick_p50_ms_live25/...): the _ms SUFFIX rule
                           # misses the trailing fraction tag
-                          "tick_p50")
+                          "tick_p50",
+                          # "hop_ms": the kernel_fusion section's per-hop
+                          # ring walls (ring_hop_ms_fused/_unfused): the
+                          # _ms SUFFIX rule misses the trailing schedule
+                          # tag
+                          "hop_ms")
 
 
 # memory-ledger rows (ISSUE 15): peak-byte watermarks and the

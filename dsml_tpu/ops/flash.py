@@ -66,6 +66,7 @@ __all__ = [
     "flash_attention",
     "flash_attention_lse",
     "flash_block_grads",
+    "flash_stream_hop",
     "ring_flash_attention",
 ]
 
@@ -194,9 +195,15 @@ def _positions(qs, ks, qi, ki, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(qs_ref, ks_ref, kstop_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale, causal, block_q, block_k, kv_blocks, mask_kv):
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
+def _fwd_kernel(qs_ref, ks_ref, kstop_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale, causal, block_q, block_k, kv_blocks, mask_kv, qi=None, ki=None):
+    # qi/ki may be pre-read grid indices: a wrapping kernel that delegates
+    # here from inside pl.when must hoist its program_id reads to the top
+    # level — interpret mode substitutes the primitive only when it's bound
+    # in the outer kernel jaxpr, not inside a cond branch
+    if qi is None:
+        qi = pl.program_id(1)
+    if ki is None:
+        ki = pl.program_id(2)
     qs, ks = qs_ref[0], ks_ref[0]
 
     @pl.when(ki == 0)
@@ -289,6 +296,181 @@ def _flash_fwd(q, k, v, q_start, k_start, kv_stop, causal, block_q, block_k, int
 
 def _scalar(x):
     return jnp.atleast_1d(jnp.asarray(x, jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# fused ring hop: flash forward + in-kernel KV streaming to the neighbor
+# ---------------------------------------------------------------------------
+
+
+def _stream_fwd_kernel(qs_ref, ks_ref, kstop_ref, pred_ref, nbr_ref,
+                       q_ref, k_ref, v_ref, ksend_ref, vsend_ref,
+                       o_ref, lse_ref, knext_ref, vnext_ref,
+                       acc, m_scr, l_scr, send_sem, recv_sem, *,
+                       scale, causal, block_q, block_k, q_blocks, kv_blocks,
+                       n_bh, mask_kv, barrier):
+    """:func:`_fwd_kernel` with the ring hop absorbed: at the FIRST grid
+    step the resident KV shard starts a remote async copy into the
+    neighbor's receive buffers (``pltpu.make_async_remote_copy``), the
+    whole flash grid then computes while those bytes fly, and the LAST
+    grid step waits both directions' semaphores — the MXU never idles on
+    an XLA-visible ppermute between hops. ``pred_ref`` carries the causal
+    hop-skip predicate INTO the kernel (a skipped pair writes the
+    (0, lse-floor) identity the ring merge ignores) because the stream
+    must run even when the math doesn't — every block tours the full
+    ring regardless of masking. ``nbr_ref`` = (destination, source)
+    logical device ids; the barrier handshake makes sure both neighbors'
+    kernels (and so their receive buffers) exist before any send."""
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    def _rdma(i, src, dst):
+        return pltpu.make_async_remote_copy(
+            src, dst, send_sem.at[i], recv_sem.at[i],
+            device_id=nbr_ref[0],
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    first = (b == 0) & (qi == 0) & (ki == 0)
+    last = ((b == n_bh - 1) & (qi == q_blocks - 1) & (ki == kv_blocks - 1))
+
+    @pl.when(first)
+    def _send():
+        if barrier:
+            # both neighbors must have entered this collective before a
+            # byte moves — their receive buffers are this kernel's outputs
+            bsem = pltpu.get_barrier_semaphore()
+            pltpu.semaphore_signal(
+                bsem, 1, device_id=nbr_ref[0],
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            pltpu.semaphore_signal(
+                bsem, 1, device_id=nbr_ref[1],
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            pltpu.semaphore_wait(bsem, 2)
+        _rdma(0, ksend_ref, knext_ref).start()
+        _rdma(1, vsend_ref, vnext_ref).start()
+
+    @pl.when(pred_ref[0] != 0)
+    def _math():
+        _fwd_kernel(qs_ref, ks_ref, kstop_ref, q_ref, k_ref, v_ref,
+                    o_ref, lse_ref, acc, m_scr, l_scr, scale=scale,
+                    causal=causal, block_q=block_q, block_k=block_k,
+                    kv_blocks=kv_blocks, mask_kv=mask_kv, qi=qi, ki=ki)
+
+    @pl.when((pred_ref[0] == 0) & (ki == kv_blocks - 1))
+    def _masked():
+        # the hop-skip identity: zero out, floored lse — exactly what the
+        # unfused ring's lax.cond branch emits, so the merge math is
+        # bit-identical between schedules
+        o_ref[0] = jnp.zeros_like(o_ref[0])
+        lse_ref[0] = jnp.full_like(lse_ref[0], -1e30)
+
+    @pl.when(last)
+    def _settle():
+        _rdma(0, ksend_ref, knext_ref).wait()
+        _rdma(1, vsend_ref, vnext_ref).wait()
+
+
+def flash_stream_hop(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    pred,
+    dst,
+    src,
+    causal: bool = True,
+    q_start: jax.Array | int = 0,
+    k_start: jax.Array | int = 0,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+    collective_id: int = 7,
+):
+    """One FUSED ring-attention hop: flash attention of ``q`` against the
+    resident ``k``/``v`` shard while that same shard streams to logical
+    device ``dst`` inside the kernel's DMA pipeline. Returns
+    ``(out, lse, k_next, v_next)`` — the attention pair for the merge plus
+    the NEXT hop's residents, received from ``src`` (the opposite ring
+    neighbor) into this call's output buffers.
+
+    ``pred`` is the causal hop-skip predicate (traced bool): when false
+    the kernel skips every score block and emits the ``(0, −1e30)`` merge
+    identity, but the KV stream still runs — masked hops move bytes, not
+    math, exactly like the unfused schedule's bare ppermute. The compute
+    operands ride the padded-block path (odd shard lengths); the STREAMED
+    buffers are the unpadded originals, so wire bytes match
+    ``ring_kv_wire_bytes`` exactly.
+
+    Logical device ids index ``jax.devices()`` order, which equals the
+    ring rank only when the ring axis is the mesh's sole (or major-order
+    equivalent) axis — ``ops.ring_attention`` only routes here under that
+    condition (``DSML_RING_FUSED=dma``). Off-TPU the kernel runs under
+    the Pallas interpreter, whose remote-copy emulation is how CI pins
+    hop parity on the CPU mesh."""
+    b, h, s_q, d = q.shape
+    s_kv = k.shape[2]
+    block_q, block_k = _default_blocks(s_q, s_kv, block_q, block_k, d)
+    bq, pq = _pad_choice(s_q, block_q)
+    bk, pk = _pad_choice(s_kv, block_k)
+    if interpret is None:
+        interpret = _interpret_default()
+    mask_kv = pk != s_kv
+    qf, kf, vf = _flat3(q), _flat3(k), _flat3(v)
+    ksend, vsend = kf, vf  # unpadded residents are what tours the ring
+    if pq != s_q:
+        qf = jnp.pad(qf, ((0, 0), (0, pq - s_q), (0, 0)))
+    if mask_kv:
+        kf = jnp.pad(kf, ((0, 0), (0, pk - s_kv), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, pk - s_kv), (0, 0)))
+    kv_stop = k_start + s_kv
+    bh = qf.shape[0]
+    q_blocks, kv_blocks = pq // bq, pk // bk
+    kernel = functools.partial(
+        _stream_fwd_kernel, scale=d ** -0.5, causal=causal, block_q=bq,
+        block_k=bk, q_blocks=q_blocks, kv_blocks=kv_blocks, n_bh=bh,
+        mask_kv=mask_kv, barrier=not interpret,
+    )
+    any_spec = pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)
+    nbr = jnp.stack([jnp.asarray(dst, jnp.int32), jnp.asarray(src, jnp.int32)])
+    pred_arr = jnp.atleast_1d(jnp.asarray(pred, jnp.int32))
+    out, lse, k_next, v_next = pl.pallas_call(
+        kernel,
+        grid=(bh, q_blocks, kv_blocks),
+        in_specs=[
+            _smem_spec(), _smem_spec(), _smem_spec(),
+            _smem_spec(), _smem_spec(),
+            _vmem_spec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
+            _vmem_spec((1, bk, d), lambda b, qi, ki: (b, ki, 0)),
+            _vmem_spec((1, bk, d), lambda b, qi, ki: (b, ki, 0)),
+            any_spec, any_spec,
+        ],
+        out_specs=[
+            _vmem_spec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
+            _vmem_spec((1, 8, bq), lambda b, qi, ki: (b, 0, qi)),
+            any_spec, any_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, pq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 8, pq), jnp.float32),
+            jax.ShapeDtypeStruct(ksend.shape, ksend.dtype),
+            jax.ShapeDtypeStruct(vsend.shape, vsend.dtype),
+        ],
+        scratch_shapes=[
+            _scratch((bq, d)), _scratch((bq, 128)), _scratch((bq, 128)),
+            pltpu.SemaphoreType.DMA((2,)), pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.TPUCompilerParams(
+            collective_id=collective_id,
+        ) if not interpret else None,
+        interpret=interpret,
+    )(_scalar(q_start), _scalar(k_start), _scalar(kv_stop), pred_arr, nbr,
+      qf, kf, vf, ksend, vsend)
+    if pq != s_q:
+        out = out[:, :s_q]
+        lse = lse[:, :, :s_q]
+    return (out.reshape(b, h, s_q, d), lse[:, 0, :].reshape(b, h, s_q),
+            k_next.reshape(b, h, s_kv, d), v_next.reshape(b, h, s_kv, d))
 
 
 # ---------------------------------------------------------------------------

@@ -45,18 +45,30 @@ activation path; the GPT-2 int4 KV cache shares :func:`pack_int4` /
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU builds too; guard anyway
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
 
 __all__ = [
     "QuantizedTensor",
     "QuantScheme",
+    "QuantizedWeight",
     "get_scheme",
     "default_qblock",
     "quant_algorithm_for",
+    "weight_quant_mode",
+    "quantize_weight_blocks",
+    "dequantize_weight_blocks",
+    "quantized_matmul",
     "pack_int4",
     "unpack_int4",
     "quantize_kv_rows",
@@ -356,6 +368,269 @@ def kv_row_bytes(head_dim: int, mode: str | None) -> int:
             raise ValueError(f"int4 KV rows need an even head_dim, got {head_dim}")
         return head_dim // 2 + 4  # two nibbles per byte + one f32 scale
     raise ValueError(f"unknown KV quant mode {mode!r}")
+
+
+# ---------------------------------------------------------------------------
+# Blocked weight quantization + the dequant-fused decode matmul
+# ---------------------------------------------------------------------------
+# Decode is weight-HBM-bandwidth-bound: the matmul's cost is reading the
+# weight, not the FLOPs. The w8a16 per-channel path (models.common.
+# quantize_weights_int8) already halves/quarters the bytes and lets XLA fuse
+# the convert into the read; this section is the KERNEL form of the same
+# idea — weights live in HBM as int8 or nibble-packed int4 with one f32
+# scale per (k-block, output channel), and a Pallas matmul unpacks the
+# integers INSIDE VMEM and folds the scale AFTER each per-block dot
+# (sum_k x·q is integer-exact in f32; one multiply per block per channel
+# recovers the dequantized partial sum). The full-width weight never exists
+# outside a VMEM tile, at 4x (int8) / 8x (int4) HBM compression vs f32.
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantizedWeight:
+    """A block-quantized matmul weight contracting on its FIRST axis.
+
+    ``qw`` holds the integer codes over the PADDED 2-D form ``[d_p, n_p]``
+    (int8) or ``[d_p // 2, n_p]`` (int4: each k-block's two row-halves
+    packed hi/lo per byte — :func:`pack_int4`'s halves convention applied
+    along the contraction axis, so the in-kernel unpack is two shift/mask
+    ops and a concat, never a gather). ``qs`` is one f32 scale per
+    (k-block, output channel): ``[d_p // block, n_p]``. The ORIGINAL shape
+    and dtype ride as static aux so the tensor crosses jit boundaries and
+    ``jax.tree`` maps like any param leaf."""
+
+    qw: jax.Array  # int8 [d_p, n_p] | uint8 [d_p//2, n_p]
+    qs: jax.Array  # f32 [d_p // block, n_p]
+    scheme: str  # "int8" | "int4" (static)
+    block: int  # k elements per scale block (static)
+    shape: tuple  # original weight shape, first axis = contraction (static)
+    dtype: object  # original dtype (static)
+
+    def tree_flatten(self):
+        return (self.qw, self.qs), (self.scheme, self.block, self.shape, self.dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+    @property
+    def hbm_bytes(self) -> int:
+        """Resident compressed bytes: packed codes + scales."""
+        return int(self.qw.nbytes + self.qs.nbytes)
+
+    @property
+    def dense_bytes(self) -> int:
+        """What the SAME weight would cost dense at its original dtype —
+        the compression-ratio denominator the bench row reports."""
+        import numpy as np
+
+        n = 1
+        for s in self.shape:
+            n *= int(s)
+        return n * jnp.dtype(self.dtype).itemsize if n else 0
+
+
+def weight_quant_mode() -> str | None:
+    """The serving weight-codec knob: ``DSML_WEIGHT_QUANT`` ∈ {unset/"0"/
+    "off"/"none" (full-precision weights), "int8"/"8", "int4"/"4"}.
+    Malformed values degrade to off — a bad env var must never refuse to
+    serve. Read once per batcher construction (docs/TUNING.md § Kernel
+    fusion)."""
+    raw = os.environ.get("DSML_WEIGHT_QUANT", "").strip().lower()
+    if raw in ("int8", "8"):
+        return "int8"
+    if raw in ("int4", "4"):
+        return "int4"
+    return None
+
+
+def _weight_pads(d: int, n: int, block: int) -> tuple[int, int, int]:
+    """(kb, d_p, n_p): the effective k-block and padded operand dims. The
+    contraction axis pads only to the 8-row sublane (≤ 7 wasted rows) and
+    ``kb`` is the LARGEST multiple-of-8 divisor of that padded length not
+    exceeding the scheme block — never a round-up to a full block, which
+    would pad a 768-deep projection to 1024 and eat a third of the
+    compression the codec exists to buy. Real model dims (768, 3072,
+    4096 …) land on kb ∈ {384, 512} with zero waste; channels pad to the
+    128-lane width (zero columns, scale 1 — exact zeros)."""
+    d_p = -(-d // 8) * 8
+    cap = min(int(block), d_p)
+    kb = max(k for k in range(8, cap + 1, 8) if d_p % k == 0)
+    n_p = -(-n // 128) * 128
+    return kb, d_p, n_p
+
+
+def quantize_weight_blocks(w: jax.Array, scheme="int8",
+                           block: int | None = None) -> QuantizedWeight:
+    """Block-quantize a matmul weight for the dequant-fused kernel:
+    deterministic round-to-nearest, symmetric absmax per (k-block, output
+    channel). ``w``'s FIRST axis is the contraction axis; trailing axes
+    flatten into output channels (GPT-2's fused ``wqkv [d, 3, d]`` keeps a
+    scale per (block, slot, channel) exactly like the per-channel path).
+    Zero blocks take scale 1.0 so padding quantizes to exact zeros — pad
+    rows contribute nothing to any dot."""
+    sch = get_scheme(scheme, block)
+    if w.ndim < 2:
+        raise ValueError(f"weight quant needs a matmul weight, got shape {w.shape}")
+    d = int(w.shape[0])
+    orig_shape = tuple(int(s) for s in w.shape)
+    wf = w.astype(jnp.float32).reshape(d, -1)
+    n = int(wf.shape[1])
+    kb, d_p, n_p = _weight_pads(d, n, sch.block)
+    if (d_p, n_p) != (d, n):
+        wf = jnp.pad(wf, ((0, d_p - d), (0, n_p - n)))
+    nb = d_p // kb
+    blocks = wf.reshape(nb, kb, n_p)
+    a = jnp.max(jnp.abs(blocks), axis=1)  # [nb, n_p]
+    qs = jnp.where(a > 0, a / sch.qmax, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(blocks / qs[:, None, :]), -sch.qmax, sch.qmax)
+    if sch.bits == 4:
+        half = kb // 2
+        hi = q[:, :half].astype(jnp.int32) + 8
+        lo = q[:, half:].astype(jnp.int32) + 8
+        qw = (hi << 4 | lo).astype(jnp.uint8).reshape(d_p // 2, n_p)
+    else:
+        qw = q.astype(jnp.int8).reshape(d_p, n_p)
+    return QuantizedWeight(qw, qs, sch.name, kb, orig_shape, w.dtype)
+
+
+def _unpack_weight_block(raw: jax.Array, int4: bool) -> jax.Array:
+    """One VMEM weight tile → f32 codes: int4 tiles hold a k-block's two
+    row-halves per byte (hi nibbles = rows [0, kb/2), lo = [kb/2, kb)) —
+    THE same float sequence the reference dequantization commits to, so
+    kernel and oracle agree exactly on int-representable values."""
+    if int4:
+        hi = (raw >> 4).astype(jnp.int8) - 8
+        lo = (raw & 0xF).astype(jnp.int8) - 8
+        return jnp.concatenate([hi, lo], axis=0).astype(jnp.float32)
+    return raw.astype(jnp.float32)
+
+
+def dequantize_weight_blocks(qwt: QuantizedWeight) -> jax.Array:
+    """Reference inverse → f32 at the ORIGINAL shape. The serving hot path
+    never calls this on-device (that would materialize the full-width
+    weight in HBM — exactly what the fused kernel exists to avoid); it is
+    the parity oracle and the XLA fallback's operand."""
+    nb, n_p = qwt.qs.shape
+    kb = qwt.block
+    if qwt.scheme == "int4":
+        raw = qwt.qw.reshape(nb, kb // 2, n_p)
+        hi = (raw >> 4).astype(jnp.int8) - 8
+        lo = (raw & 0xF).astype(jnp.int8) - 8
+        q = jnp.concatenate([hi, lo], axis=1).astype(jnp.float32)
+    else:
+        q = qwt.qw.reshape(nb, kb, n_p).astype(jnp.float32)
+    full = (q * qwt.qs[:, None, :]).reshape(nb * kb, n_p)
+    d = qwt.shape[0]
+    n = 1
+    for s in qwt.shape[1:]:
+        n *= int(s)
+    return full[:d, :n].reshape(qwt.shape)
+
+
+def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc, *, nb, int4):
+    """Grid (m tiles, n tiles, k blocks), k innermost: each step unpacks
+    one weight tile in VMEM, takes the integer-code dot, and folds the
+    per-(block, channel) scale AFTER the dot — one multiply per partial
+    sum instead of one per weight element."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    w = _unpack_weight_block(w_ref[:], int4)
+    part = jax.lax.dot_general(
+        x_ref[:].astype(jnp.float32), w,
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    acc[:] = acc[:] + part * s_ref[:]
+
+    @pl.when(ki == nb - 1)
+    def _flush():
+        o_ref[:] = acc[:]
+
+
+def quantized_matmul_vmem_bytes(bm: int, kb: int, bn: int, int4: bool) -> int:
+    """Analytic VMEM working set of one fused-matmul grid step, at the
+    Mosaic-padded footprint, with Pallas' automatic double buffering on
+    every streamed operand (×2) — the guard the kernel route checks
+    before committing to a block shape (docs/TUNING.md § Kernel fusion)."""
+    from dsml_tpu.ops.vmem_budget import vmem_block_bytes
+
+    x_b = vmem_block_bytes((bm, kb), 4)
+    w_b = vmem_block_bytes((kb // 2, bn) if int4 else (kb, bn), 1)
+    s_b = vmem_block_bytes((1, bn), 4)
+    o_b = vmem_block_bytes((bm, bn), 4)
+    acc = vmem_block_bytes((bm, bn), 4)
+    return 2 * (x_b + w_b + s_b + o_b) + acc
+
+
+def quantized_matmul(x: jax.Array, qwt: QuantizedWeight,
+                     interpret: bool | None = None) -> jax.Array:
+    """``x [m, d] @ dequant(qwt) → f32 [m, n]`` with the dequantization
+    fused into the matmul: integer codes stream HBM→VMEM at their packed
+    width, unpack + scale-fold happen per VMEM tile. Off-TPU the kernel
+    runs under the Pallas interpreter (same float sequence — the CPU
+    parity pin); a block shape that would blow the VMEM budget falls back
+    to the XLA dequantize-then-dot path with a warn-once (the fallback
+    DOES materialize the f32 weight — slower and bigger, but it serves)."""
+    from dsml_tpu.ops.vmem_budget import fits_vmem, warn_once
+
+    m, d = x.shape
+    nb, n_p = qwt.qs.shape
+    kb = qwt.block
+    d_p = nb * kb
+    int4 = qwt.scheme == "int4"
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    bm = -(-m // 8) * 8
+    if bm > 128:
+        bm = 128
+    m_p = -(-m // bm) * bm
+    bn = 128
+    if not fits_vmem(quantized_matmul_vmem_bytes(bm, kb, bn, int4)):
+        warn_once(
+            f"qmm-vmem-{bm}-{kb}-{bn}-{qwt.scheme}",
+            f"dequant-fused matmul block ({bm}x{kb}x{bn}, {qwt.scheme}) "
+            f"exceeds the VMEM budget; falling back to the XLA "
+            f"dequantize-then-dot path (set DSML_VMEM_LIMIT_MB or shrink "
+            f"DSML_QBLOCK)",
+        )
+        return x.astype(jnp.float32) @ dequantize_weight_blocks(
+            qwt
+        ).reshape(d, -1)
+    xf = x.astype(jnp.float32)
+    if (m_p, d_p) != (m, d):
+        xf = jnp.pad(xf, ((0, m_p - m), (0, d_p - d)))
+    grid = (m_p // bm, n_p // bn, nb)
+    kernel = functools.partial(_qmm_kernel, nb=nb, int4=int4)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, kb), lambda mi, ni, ki: (mi, ki),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kb // 2 if int4 else kb, bn),
+                         lambda mi, ni, ki: (ki, ni),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bn), lambda mi, ni, ki: (ki, ni),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda mi, ni, ki: (mi, ni),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m_p, n_p), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ) if not interpret else None,
+        interpret=interpret,
+    )(xf, qwt.qw, qwt.qs)
+    n = 1
+    for s in qwt.shape[1:]:
+        n *= int(s)
+    return out[:m, :n]
 
 
 def _block_quant(blocks: jax.Array, scheme: QuantScheme, seed=None):

@@ -49,19 +49,40 @@ by the shard origin exactly as for the legacy sp ring).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from dsml_tpu.ops.collectives import ring_pass
-from dsml_tpu.ops.flash import flash_attention, flash_attention_lse, flash_block_grads
+from dsml_tpu.ops.flash import (flash_attention, flash_attention_lse,
+                                flash_block_grads, flash_stream_hop)
 
-__all__ = ["ring_attention", "ring_kv_wire_bytes", "causal_keep_fraction",
-           "causal_critical_path_fraction", "zigzag_indices",
-           "zigzag_inverse"]
+__all__ = ["ring_attention", "ring_fused_mode", "ring_kv_wire_bytes",
+           "causal_keep_fraction", "causal_critical_path_fraction",
+           "zigzag_indices", "zigzag_inverse"]
 
 _LSE_FLOOR = -1e30  # "nothing seen": logaddexp identity, exp(floor − x) = 0
+
+
+def ring_fused_mode() -> str | None:
+    """The fused KV-stream knob: ``DSML_RING_FUSED`` ∈ {"0"/"off" (unset
+    default — the XLA-ppermute oracle schedule), "1"/"on"/"sendahead"
+    (hop ``i+1``'s KV ppermute issues BEFORE hop ``i``'s flash calls, so
+    the async collective overlaps the math — portable to any mesh),
+    "dma" (the per-hop flash call absorbs the neighbor exchange as an
+    in-kernel remote async copy — ``ops.flash.flash_stream_hop``;
+    requires the ring axis to be the mesh's only axis, since the kernel
+    addresses neighbors by LOGICAL device id)}. Read at trace time.
+    Every mode computes the same merges in the same order — parity is
+    pinned at cp ∈ {2, 4}, fwd and bwd, both layouts."""
+    raw = os.environ.get("DSML_RING_FUSED", "").strip().lower()
+    if raw in ("1", "on", "true", "sendahead", "auto"):
+        return "sendahead"
+    if raw == "dma":
+        return "dma"
+    return None
 
 
 def _halves(s_local: int) -> list[tuple[int, int, int]]:
@@ -182,10 +203,20 @@ def _keep_pair(layout, causal, hop, src, rank, k_start, q_gs, q_len):
 
 
 def _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k, interpret,
-                   layout):
+                   layout, fused=None):
     """n-hop bidirectional forward. Returns (out f32, lse f32) — exact full
     attention for this rank's query shard (rows in shard-local order; the
-    zigzag layout's rows are the rank's two stripes back to back)."""
+    zigzag layout's rows are the rank's two stripes back to back).
+
+    ``fused`` picks the hop SCHEDULE (:func:`ring_fused_mode`), never the
+    math: ``None`` rotates residents with a ppermute after each hop's
+    flash calls (the oracle); ``"sendahead"`` issues the rotation BEFORE
+    the hop's flash calls — no data dependence between them, so the
+    async collective overlaps the MXU work; ``"dma"`` hands each
+    direction's hop to :func:`ops.flash.flash_stream_hop`, which streams
+    the resident half to the neighbor inside the kernel while the same
+    kernel computes on it. All three fold identical (out, lse) pairs in
+    identical order."""
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
@@ -200,11 +231,18 @@ def _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k, interpret,
                 for start, length, sign in halves}
 
     for hop in range(n):
+        incoming: dict = {}
+        if fused == "sendahead" and hop != n - 1:
+            # next hop's KV stream launches before this hop's math — the
+            # flash calls don't consume it, so the collective flies under
+            # the compute instead of serializing after it
+            incoming = {sign: ring_pass(kv, axis_name, sign)
+                        for sign, kv in resident.items()}
         for start, length, sign in halves:
             kh, vh = resident[sign]
             src = (rank - sign * hop) % n  # whose half is resident this hop
             k_start = _kv_global_start(layout, src, start, s_local, n)
-            for q_row, q_len, q_gs in qblocks:
+            for q_idx, (q_row, q_len, q_gs) in enumerate(qblocks):
                 qb = q[:, :, q_row:q_row + q_len]
 
                 def compute(qb, kh, vh, k_start=k_start, q_gs=q_gs):
@@ -217,7 +255,23 @@ def _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k, interpret,
 
                 always, pred = _keep_pair(layout, causal, hop, src, rank,
                                           k_start, q_gs, q_len)
-                if always:
+                if fused == "dma" and hop != n - 1 and q_idx == 0:
+                    # the hop rides the first q block's kernel: flash +
+                    # in-kernel remote copy of (kh, vh) to the next rank;
+                    # the skip predicate travels into the kernel because
+                    # masked hops still move their bytes
+                    o, l, k_nxt, v_nxt = flash_stream_hop(
+                        qb, kh, vh,
+                        jnp.bool_(True) if always else pred,
+                        dst=(rank + sign) % n, src=(rank - sign) % n,
+                        causal=causal, q_start=q_gs, k_start=k_start,
+                        block_q=block_q, block_k=block_k,
+                        interpret=interpret,
+                        collective_id=7 if sign > 0 else 8,
+                    )
+                    o = o.astype(jnp.float32)
+                    incoming[sign] = (k_nxt, v_nxt)
+                elif always:
                     o, l = compute(qb, kh, vh)
                 else:
                     # fully-masked pair: skip the flash call (the MXU
@@ -236,28 +290,30 @@ def _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k, interpret,
                 run_out = run_out.at[:, :, q_row:q_row + q_len].set(mo)
                 run_lse = run_lse.at[:, :, q_row:q_row + q_len].set(ml)
         if hop != n - 1:
-            resident = {sign: ring_pass(kv, axis_name, sign)
-                        for sign, kv in resident.items()}
+            resident = (incoming if fused in ("sendahead", "dma")
+                        else {sign: ring_pass(kv, axis_name, sign)
+                              for sign, kv in resident.items()})
     return run_out, run_lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _ring(q, k, v, axis_name, causal, block_q, block_k, interpret, layout):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _ring(q, k, v, axis_name, causal, block_q, block_k, interpret, layout,
+          fused):
     out, _ = _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k,
-                            interpret, layout)
+                            interpret, layout, fused)
     return out.astype(q.dtype)
 
 
 def _ring_fwd_rule(q, k, v, axis_name, causal, block_q, block_k, interpret,
-                   layout):
+                   layout, fused):
     out, lse = _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k,
-                              interpret, layout)
+                              interpret, layout, fused)
     # residuals are this rank's RESIDENTS only — O(S/cp), the whole point
     return out.astype(q.dtype), (q, k, v, out.astype(q.dtype), lse)
 
 
 def _ring_bwd_rule(axis_name, causal, block_q, block_k, interpret, layout,
-                   res, g):
+                   fused, res, g):
     q, k, v, out, lse = res
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
@@ -276,6 +332,18 @@ def _ring_bwd_rule(axis_name, causal, block_q, block_k, interpret, layout,
              for start, length, sign in halves}
 
     for hop in range(n):
+        kv_ahead: dict = {}
+        if fused and hop != n - 1:
+            # the K/V legs of the rotation have no dependence on this
+            # hop's grads — stream them ahead so the transfer overlaps
+            # the block-gradient math; the dk/dv accumulators can only
+            # leave AFTER the hop's compute has folded into them, so
+            # they rotate behind (same wire volume, earlier departure
+            # for the bytes that CAN go early). The in-kernel "dma"
+            # forward shares this backward: the dkv payload is produced
+            # by the very kernel that would have to send it.
+            kv_ahead = {sign: ring_pass((s[0], s[1]), axis_name, sign)
+                        for sign, s in state.items()}
         for start, length, sign in halves:
             kh, vh, dkh, dvh = state[sign]
             src = (rank - sign * hop) % n
@@ -313,8 +381,13 @@ def _ring_bwd_rule(axis_name, causal, block_q, block_k, interpret, layout,
                 dvh = dvh + dv_p
             state[sign] = (kh, vh, dkh, dvh)
         if hop != n - 1:
-            state = {sign: ring_pass(s, axis_name, sign)
-                     for sign, s in state.items()}
+            if fused:
+                state = {sign: kv_ahead[sign] + ring_pass(
+                    (s[2], s[3]), axis_name, sign)
+                    for sign, s in state.items()}
+            else:
+                state = {sign: ring_pass(s, axis_name, sign)
+                         for sign, s in state.items()}
 
     # final hop: after compute at hop n−1 the resident block belongs to rank
     # (rank + sign) mod n — one more rotation in the SAME direction lands
@@ -342,11 +415,20 @@ def ring_attention(
     block_k: int | None = None,
     interpret: bool | None = None,
     layout: str = "contiguous",
+    fused: str | None = "env",
 ) -> jax.Array:
     """Exact attention over a sequence sharded along ``axis_name`` (the
     ``cp`` mesh axis), one flash call per visiting KV half-block — call
     under ``shard_map`` with q/k/v = this rank's shard
     [batch, heads, S/cp, head_dim].
+
+    ``fused`` selects the hop schedule: ``"env"`` (default) defers to
+    ``DSML_RING_FUSED`` (:func:`ring_fused_mode`), ``None``/"off" is the
+    XLA-ppermute oracle, ``"sendahead"`` overlaps each hop's KV rotation
+    with its flash calls, ``"dma"`` absorbs the rotation into the flash
+    kernel as an in-kernel remote copy (single-axis meshes). The
+    schedule never changes the math — fwd/bwd parity across all modes
+    is pinned at cp ∈ {2, 4}, both layouts.
 
     Bidirectional KV streaming (each direction moves half the volume),
     causal hop skipping, and a memory-lean backward that re-streams KV
@@ -368,6 +450,14 @@ def ring_attention(
         raise ValueError(
             f"layout must be 'contiguous' or 'zigzag', got {layout!r}"
         )
+    if fused == "env":
+        fused = ring_fused_mode()
+    elif fused in ("off", "none", "0"):
+        fused = None
+    if fused not in (None, "sendahead", "dma"):
+        raise ValueError(
+            f"fused must be None, 'sendahead' or 'dma', got {fused!r}"
+        )
     n = lax.axis_size(axis_name)
     if n == 1:
         return flash_attention(q, k, v, causal, block_q, block_k, interpret)
@@ -376,7 +466,7 @@ def ring_attention(
             f"zigzag needs an even per-rank length, got {q.shape[2]}"
         )
     return _ring(q, k, v, axis_name, causal, block_q, block_k, interpret,
-                 layout)
+                 layout, fused)
 
 
 def ring_kv_wire_bytes(

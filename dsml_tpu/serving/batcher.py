@@ -261,6 +261,7 @@ class ContinuousBatcher:
         n_pages: int = 0,
         preemption: bool = False,
         preempt_policy: str = "auto",
+        weight_quant: str | None = "env",
     ):
         """``mesh`` — a framework mesh (``parallel.mesh.build_mesh``) makes
         serving TENSOR-PARALLEL: params are Megatron-sharded
@@ -268,6 +269,17 @@ class ContinuousBatcher:
         axis shards over 'tp', and prefill/decode run head-parallel under
         shard_map with the full logits row reconstructed for sampling —
         same tokens as the single-device batcher (tests pin it).
+
+        ``weight_quant`` — serving weight codec for the dequant-fused
+        matmul path: ``"env"`` (default) reads ``DSML_WEIGHT_QUANT``
+        (off unless set), ``"int8"``/``"int4"`` block-quantize the
+        transformer matmul weights (``models.common.
+        quantize_weights_blocked``) so they sit in HBM at ~4×/~8×
+        compression and dequantize one VMEM tile at a time inside the
+        Pallas matmul; ``None``/"off" serves the params as given. The
+        compressed bytes are claimed in the memory ledger under
+        ``weights_quant``. Single-device replicas only (the TP shard_map
+        path expects plain leaves matching ``param_specs``).
 
         ``preemption`` (paged only) — replace up-front worst-case page
         reservation with an eviction tier: admission reserves only the
@@ -395,6 +407,49 @@ class ContinuousBatcher:
         # is the fleet's decode worker — a standalone batcher does both
         # jobs but reports as "decode" (docs/OBSERVABILITY.md)
         self.obs_role = "decode"
+        # ---- dequant-fused serving weights (docs/TUNING.md § Kernel
+        # fusion) — resolve the knob, compress the params BEFORE any
+        # decode program closes over them, and claim the compressed
+        # bytes so the ledger's params row reconciles
+        if weight_quant == "env":
+            from dsml_tpu.ops.quantization import weight_quant_mode
+
+            weight_quant = weight_quant_mode()
+        if weight_quant in ("off", "none", "0", False):
+            weight_quant = None
+        if weight_quant is not None:
+            if weight_quant not in ("int8", "int4"):
+                raise ValueError(
+                    f"weight_quant must be 'int8', 'int4', or None, got "
+                    f"{weight_quant!r}"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "weight_quant serves single-device replicas; the TP "
+                    "shard_map path expects plain param leaves matching "
+                    "param_specs"
+                )
+            from dsml_tpu.models.common import quantize_weights_blocked
+            from dsml_tpu.ops.quantization import QuantizedWeight
+
+            params = quantize_weights_blocked(params, weight_quant)
+            packed = scales = 0
+            for leaf in jax.tree.leaves(
+                params, is_leaf=lambda l: isinstance(l, QuantizedWeight)
+            ):
+                if isinstance(leaf, QuantizedWeight):
+                    packed += int(leaf.qw.nbytes)
+                    scales += int(leaf.qs.nbytes)
+            self._wq_bytes = {"packed": packed, "scales": scales}
+            from dsml_tpu.obs.memory import get_memory_ledger
+
+            get_memory_ledger(self._obs).register_source(
+                "weights_quant", self._ledger_weight_quant_bytes,
+                name=f"{self.obs_replica}/{self.obs_role}/{id(self):x}",
+            )
+        else:
+            self._wq_bytes = {}
+        self.weight_quant = weight_quant
         # handed-off admissions awaiting a free slot: (Request, cache1,
         # logits row) — prefilled elsewhere, so admission is insert-only
         self._inject: deque = deque()
@@ -2127,6 +2182,13 @@ class ContinuousBatcher:
             "free": self._pages.free_pages * bpp,
             "scratch": bpp,
         }
+
+    def _ledger_weight_quant_bytes(self) -> dict:
+        """Ledger source body: the compressed serving weights' resident
+        device bytes, packed codes and scales split — the acceptance pin
+        that quantized weights never ride HBM at full width (the ratio of
+        the params row to this one is the codec's compression)."""
+        return dict(self._wq_bytes)
 
     def memory_pressure(self) -> float:
         """Device-memory pressure in [0, 1] — the preemption tier's and

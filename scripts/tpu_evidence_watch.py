@@ -57,6 +57,12 @@ SECTIONS = [
     #                            live-vs-table HBM A/B + parity/tp2/eviction
     #                            verdicts (virtual-8 CPU subprocess; on
     #                            chips the kernel path runs compiled)
+    ("kernel_fusion", 900),  # the three deep fusions A/B'd vs their parity
+    #                          oracles: pipelined paged DMA, in-ring fused
+    #                          KV hop, dequant-fused matmuls — on chips the
+    #                          tick/hop walls become the REAL overlap
+    #                          evidence the CPU provenance labels defer
+    #                          (virtual-8 CPU subprocess otherwise)
     ("long_context", 3000),  # cp=8 ring-attention ladder to 128k tokens
     #                          (virtual-8 CPU subprocess; completion, exact
     #                          KV wire bytes, headroom + parity verdicts)

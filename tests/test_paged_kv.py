@@ -10,6 +10,7 @@ width is pure scheduling: tokens identical at any width).
 """
 
 import dataclasses
+import gc
 
 import jax.numpy as jnp
 import numpy as np
@@ -748,6 +749,43 @@ def test_preemption_constructor_and_submit_validation(setup):
                             preemption=True)
     with pytest.raises(ValueError, match="ever reservable"):
         srv.submit(np.arange(1, 100, dtype=np.int32), 20)
+
+
+def test_preemption_pipelined_kernel_chaos_smoke(setup, monkeypatch):
+    """The kernel-fusion chaos leg (ISSUE 16): eviction-based preemption
+    under pool pressure with the DOUBLE-BUFFERED Pallas kernel enabled —
+    resumed victims re-emit tokens identical to the uncontended XLA-path
+    run, and the page-pool ledger returns to its byte-exact idle
+    baseline (all pages free + the scratch page, zero live/shared
+    bytes). The DMA slot ring must not leak state across an eviction:
+    a resumed slot's pages land elsewhere in the pool and the kernel
+    walk restarts from the table, not from stale scratch."""
+    from dsml_tpu.obs.memory import get_memory_ledger
+
+    cfg, model, params = setup
+    prompts, budgets = _pressure_prompts(cfg)
+    monkeypatch.setenv("DSML_PAGED_ATTN", "xla")
+    ref = ContinuousBatcher(model, params, n_slots=3, prefill_chunk=8,
+                            paged_kv="int4", page_size=8, n_pages=40)
+    want = _drain_tokens(ref, prompts, budgets)
+    del ref  # its WeakMethod ledger source must not pollute the claim sum
+    gc.collect()
+
+    monkeypatch.setenv("DSML_PAGED_ATTN", "pallas")
+    monkeypatch.setenv("DSML_PAGED_ATTN_PIPELINE", "1")
+    srv = ContinuousBatcher(model, params, n_slots=3, prefill_chunk=8,
+                            paged_kv="int4", page_size=8, n_pages=8,
+                            preemption=True)
+    baseline = srv._ledger_page_bytes()
+    assert baseline["live"] == baseline["shared"] == 0  # idle pool
+    assert _drain_tokens(srv, prompts, budgets) == want
+    assert srv.n_preemptions > 0  # the pressure leg actually evicted
+    assert srv.n_preempted == 0  # every victim resumed and retired
+    assert srv._ledger_page_bytes() == baseline  # byte-exact return
+    # the registered ledger source reports the same baseline split
+    claimed = get_memory_ledger(srv._obs).claimed().get("kv_pages", {})
+    if claimed:  # observability may be disabled in the default suite
+        assert sum(claimed.values()) == sum(baseline.values())
 
 
 def test_preemption_fleet_injected_slot_keeps_cow_boundary(setup):

@@ -573,3 +573,159 @@ def test_hierarchical_all_reduce_matches_flat(devices8, grid, op):
     )(jnp.asarray(x).reshape(n_outer, n_inner, 1000))
     got0 = np.asarray(got).reshape(8, 1000)[0]
     np.testing.assert_allclose(got0, flat_ref(op), rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# block-quantized weights + the dequant-fused Pallas matmul (DSML_WEIGHT_QUANT)
+# ---------------------------------------------------------------------------
+
+
+def test_blocked_weight_kernel_matches_dequant_oracle():
+    """The fused matmul vs ``x @ dequantize_weight_blocks`` — the XLA
+    fallback IS the oracle, so relative error is float-reassociation
+    noise only, across both codecs, odd shapes, and the 3-D wqkv form."""
+    from dsml_tpu.ops.quantization import (
+        dequantize_weight_blocks, quantize_weight_blocks, quantized_matmul,
+    )
+
+    rng = np.random.default_rng(0)
+    for scheme in ("int8", "int4"):
+        for (m, d, n), block in [((3, 64, 48), 512), ((7, 200, 130), 64),
+                                 ((16, 512, 256), 128)]:
+            w = jnp.asarray(rng.standard_normal((d, n)), jnp.float32)
+            x = jnp.asarray(rng.standard_normal((m, d)), jnp.float32)
+            qwt = quantize_weight_blocks(w, scheme, block)
+            deq = dequantize_weight_blocks(qwt)
+            assert deq.shape == (d, n)
+            got = np.asarray(quantized_matmul(x, qwt))
+            ref = np.asarray(x @ deq)
+            err = np.max(np.abs(got - ref)) / max(np.max(np.abs(ref)), 1e-9)
+            assert got.shape == (m, n)
+            assert err < 1e-5, (scheme, m, d, n, block, err)
+    # 3-D weight (GPT-2's fused wqkv): trailing axes flatten to columns
+    w3 = jnp.asarray(rng.standard_normal((64, 3, 32)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((5, 64)), jnp.float32)
+    qwt = quantize_weight_blocks(w3, "int4", 64)
+    deq = dequantize_weight_blocks(qwt)
+    assert deq.shape == (64, 3, 32)
+    np.testing.assert_allclose(
+        np.asarray(quantized_matmul(x, qwt)),
+        np.asarray(x @ np.asarray(deq).reshape(64, -1)),
+        rtol=1e-5, atol=1e-4)
+
+
+def test_blocked_weight_kernel_integer_exact():
+    """On codec-representable integer weights (every (block, column)
+    absmax pinned to qmax so scales are exactly 1) with small-integer
+    activations, the kernel is EXACT — scale folding after the dot loses
+    nothing the codec kept."""
+    from dsml_tpu.ops.quantization import quantize_weight_blocks, quantized_matmul
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.integers(-8, 9, (5, 96)), jnp.float32)
+    w = jnp.asarray(rng.integers(-127, 128, (96, 160)), jnp.float32)
+    w = w.at[0::32, :].set(127.0)  # absmax per (block, column) -> scale 1
+    got = np.asarray(quantized_matmul(x, quantize_weight_blocks(w, "int8", 32)))
+    assert np.array_equal(got, np.asarray(x @ w))
+
+    w4 = jnp.asarray(rng.integers(-7, 8, (96, 128)), jnp.float32)
+    w4 = w4.at[0::32, :].set(7.0)
+    got = np.asarray(quantized_matmul(x, quantize_weight_blocks(w4, "int4", 32)))
+    assert np.array_equal(got, np.asarray(x @ w4))
+
+
+def test_blocked_weight_compression_floors():
+    """HBM bytes vs the dense f32 leaf at real model dims (d=768): the
+    k-block divisor rule must not round 768 up to a block multiple — the
+    acceptance floors are 3.9x (int8) and 7.8x (int4)."""
+    from dsml_tpu.ops.quantization import quantize_weight_blocks
+
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.standard_normal((768, 768)), jnp.float32)
+    for scheme, floor in (("int8", 3.9), ("int4", 7.8)):
+        qwt = quantize_weight_blocks(w, scheme)
+        assert qwt.dense_bytes / qwt.hbm_bytes >= floor
+    # quant error bounded by the codec quantum
+    from dsml_tpu.ops.quantization import dequantize_weight_blocks
+
+    q8 = np.asarray(dequantize_weight_blocks(quantize_weight_blocks(w, "int8")))
+    lim = float(jnp.max(jnp.abs(w))) / 127 * 0.51 * 2
+    assert np.max(np.abs(q8 - np.asarray(w))) <= lim
+
+
+def test_weight_quant_mode_env_knob(monkeypatch):
+    from dsml_tpu.ops.quantization import weight_quant_mode
+
+    monkeypatch.delenv("DSML_WEIGHT_QUANT", raising=False)
+    assert weight_quant_mode() is None
+    for raw, want in [("int8", "int8"), ("8", "int8"), ("int4", "int4"),
+                      ("4", "int4"), (" INT4 ", "int4"), ("fp8", None),
+                      ("", None)]:
+        monkeypatch.setenv("DSML_WEIGHT_QUANT", raw)
+        assert weight_quant_mode() == want, raw
+
+
+def test_blocked_weight_batcher_tokens_and_ledger():
+    """The serving wire-through: ``ContinuousBatcher(weight_quant=...)``
+    quantizes at admission, serves token-exactly vs ``generate`` on the
+    same quantized params, and claims packed+scales bytes under the
+    ledger's ``weights_quant`` subsystem at >=3.9x/7.8x compression
+    (d_model=768 — the floors are stated at real dims)."""
+    from dsml_tpu.models.common import quantize_weights_blocked
+    from dsml_tpu.models.gpt2 import GPT2, GPT2Config
+    from dsml_tpu.obs.memory import get_memory_ledger
+    from dsml_tpu.ops.quantization import QuantizedWeight
+    from dsml_tpu.serving import ContinuousBatcher
+
+    cfg = GPT2Config(vocab_size=512, max_seq=64, n_layer=1, n_head=4,
+                     d_model=768, d_ff=3072)
+    model = GPT2(cfg)
+    params = model.init(7)
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, 512, 10)
+    for scheme, floor in (("int8", 3.9), ("int4", 7.8)):
+        srv = ContinuousBatcher(model, params, n_slots=2, prompt_buckets=(16,),
+                                weight_quant=scheme)
+        assert srv.weight_quant == scheme
+        rid = srv.submit(prompt, 3)
+        toks = srv.run()[rid]
+        ref = model.generate(quantize_weights_blocked(params, scheme),
+                             jnp.asarray(prompt)[None], 3)[0]
+        assert toks == np.asarray(ref).tolist()
+        wq = get_memory_ledger(srv._obs).claimed()["weights_quant"]
+        assert set(wq) == {"packed", "scales"} and wq["scales"] > 0
+        dense = sum(
+            l.dense_bytes for l in jax.tree.leaves(
+                srv.params, is_leaf=lambda l: isinstance(l, QuantizedWeight))
+            if isinstance(l, QuantizedWeight))
+        assert dense / sum(wq.values()) >= floor
+    # off stays off; TP meshes are rejected (param_specs expect plain leaves)
+    srv = ContinuousBatcher(model, params, n_slots=2, prompt_buckets=(16,),
+                            weight_quant=None)
+    assert srv.weight_quant is None and not srv._wq_bytes
+    with pytest.raises(ValueError, match="weight_quant"):
+        ContinuousBatcher(model, params, n_slots=2, prompt_buckets=(16,),
+                          weight_quant="fp8")
+
+
+def test_blocked_weight_matmul_vmem_fallback(monkeypatch, caplog):
+    """A starved VMEM budget routes the fused matmul to its XLA
+    dequant fallback with one warning — and the fallback is the oracle,
+    so the answer cannot move."""
+    from dsml_tpu.ops import vmem_budget
+    from dsml_tpu.ops.quantization import quantize_weight_blocks, quantized_matmul
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((4, 256)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((256, 256)), jnp.float32)
+    qwt = quantize_weight_blocks(w, "int4", 128)
+    want = np.asarray(quantized_matmul(x, qwt))
+    monkeypatch.setattr(vmem_budget, "_DEFAULT_VMEM_BYTES", 16 * 1024)
+    monkeypatch.delenv("DSML_VMEM_LIMIT_MB", raising=False)
+    vmem_budget._reset_for_tests()
+    with caplog.at_level("WARNING", logger="dsml_tpu.vmem"):
+        got = np.asarray(quantized_matmul(x, qwt))
+        np.asarray(quantized_matmul(x, qwt))
+    assert sum("VMEM budget" in r.message for r in caplog.records) == 1
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    vmem_budget._reset_for_tests()

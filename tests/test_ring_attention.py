@@ -409,3 +409,110 @@ def test_llama_ring2_loss_matches_ring_on_cp_mesh(devices8):
         return float(fn(placed, x, y))
 
     assert np.isclose(run("ring2"), run("ring"), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# fused KV-hop schedules (DSML_RING_FUSED): oracle ≡ sendahead ≡ dma
+# ---------------------------------------------------------------------------
+
+
+def _fused_fn(mesh, causal, fused, layout="contiguous"):
+    spec = P(None, None, "cp", None)
+    return jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, "cp", causal,
+                                           layout=layout, fused=fused),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+        )
+    )
+
+
+def test_ring_fused_mode_env_knob(monkeypatch):
+    from dsml_tpu.ops.ring_attention import ring_fused_mode
+
+    monkeypatch.delenv("DSML_RING_FUSED", raising=False)
+    assert ring_fused_mode() is None
+    for raw, want in [("0", None), ("off", None), ("1", "sendahead"),
+                      ("on", "sendahead"), ("auto", "sendahead"),
+                      ("sendahead", "sendahead"), ("DMA ", "dma")]:
+        monkeypatch.setenv("DSML_RING_FUSED", raw)
+        assert ring_fused_mode() == want, raw
+    # the public entry rejects junk instead of silently de-fusing
+    with pytest.raises(ValueError, match="fused"):
+        ring_attention(jnp.zeros((1, 1, 8, 8)), jnp.zeros((1, 1, 8, 8)),
+                       jnp.zeros((1, 1, 8, 8)), "cp", fused="bogus")
+
+
+# odd per-rank rows (66/2=33, 52/4=13) keep the padded flash path load-
+# bearing inside the streamed hop too. The causal legs are the acceptance
+# pin (both modes × both cp in the default tier); the non-causal matrix
+# rides in the slow tier — hop scheduling is mask-independent, so the
+# causal legs already exercise every fused code path.
+@pytest.mark.parametrize("cp,s", [(2, 66), (4, 52)])
+@pytest.mark.parametrize(
+    "causal", [True, pytest.param(False, marks=pytest.mark.slow)])
+@pytest.mark.parametrize("fused", ["sendahead", "dma"])
+def test_ring_fused_forward_bit_identical(devices8, cp, s, causal, fused):
+    """All three hop schedules perform the SAME merges in the SAME order
+    — fused forwards are bit-identical to the XLA-ppermute oracle, not
+    merely close (the acceptance pin that makes the oracle an oracle)."""
+    q, k, v = _qkv(s, seed=cp * 7 + s)
+    mesh = _cp_mesh(devices8, cp)
+    want = np.asarray(_fused_fn(mesh, causal, None)(q, k, v))
+    got = np.asarray(_fused_fn(mesh, causal, fused)(q, k, v))
+    assert np.array_equal(got, want)
+    np.testing.assert_allclose(
+        got, np.asarray(attention(q, k, v, causal)), rtol=2e-4, atol=2e-5)
+
+
+# default tier keeps cp ∈ {2,4} with the two modes split across them
+# (the acceptance pin); the transposed mode×cp pairings are the slow-tier
+# half of the matrix — the backward schedule differs by mode, not by cp
+@pytest.mark.parametrize("cp,s,fused", [
+    (2, 66, "sendahead"),
+    (4, 52, "dma"),
+    pytest.param(2, 66, "dma", marks=pytest.mark.slow),
+    pytest.param(4, 52, "sendahead", marks=pytest.mark.slow),
+])
+def test_ring_fused_backward_parity(devices8, cp, s, fused):
+    """Loss/grad parity: the fused backward rotates the kv legs ahead of
+    compute and homes the dk/dv accumulators after it — gradients match
+    the oracle schedule and the dense reference."""
+    q, k, v = _qkv(s, seed=cp * 31 + s)
+    mesh = _cp_mesh(devices8, cp)
+
+    def loss(fn):
+        return jax.grad(
+            lambda args: jnp.sum(jnp.tanh(fn(*args))), allow_int=False
+        )((q, k, v))
+
+    g_want = loss(_fused_fn(mesh, True, None))
+    g_got = loss(_fused_fn(mesh, True, fused))
+    for a, b in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    g_dense = jax.grad(
+        lambda args: jnp.sum(jnp.tanh(attention(*args, True)))
+    )((q, k, v))
+    for a, b in zip(g_got, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "fused", ["sendahead", pytest.param("dma", marks=pytest.mark.slow)])
+def test_ring_fused_zigzag_composes(devices8, fused):
+    """The causal load-balance layout and the fused hop are orthogonal:
+    zigzag + fused ≡ zigzag + oracle, bit for bit."""
+    cp, s = 4, 96
+    q, k, v = _qkv(s, seed=99)
+    perm = zigzag_indices(cp, s)
+    inv = zigzag_inverse(cp, s)
+    mesh = _cp_mesh(devices8, cp)
+    args = [t[:, :, perm, :] for t in (q, k, v)]
+    want = np.asarray(_fused_fn(mesh, True, None, "zigzag")(*args))
+    got = np.asarray(_fused_fn(mesh, True, fused, "zigzag")(*args))
+    assert np.array_equal(got, want)
+    np.testing.assert_allclose(
+        np.asarray(got)[:, :, inv, :], np.asarray(attention(q, k, v, True)),
+        rtol=2e-4, atol=2e-5)
